@@ -1,0 +1,19 @@
+"""cs336_systems_tpu — a TPU-native systems framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability set of the reference
+CS336 assignment-2 "systems" suite (PyTorch/Triton/NCCL):
+
+- ``models``   — pure-functional Transformer LM library (pytree params).
+- ``ops``      — numerics: softmax/CE/clip, FlashAttention-2 (Pallas TPU
+                 kernel + portable lax.scan reference), precision policies.
+- ``optim``    — from-scratch AdamW on pytrees + LR schedules.
+- ``parallel`` — device-mesh layer: DP variants, ZeRO-1, collectives.
+- ``data``     — token-array batch sampling (numpy + native C++ sampler).
+- ``utils``    — profiling/tracing, timing, checkpointing.
+
+Everything on the compute path is jit-able, static-shaped, and designed for
+the MXU (large batched matmuls, bf16 compute / fp32 accumulate) and the ICI
+(sharding via ``jax.sharding.Mesh`` + ``shard_map``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from cs336_systems_tpu.data.loader import get_batch
+
+__all__ = ["get_batch"]

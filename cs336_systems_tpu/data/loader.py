@@ -1,0 +1,53 @@
+"""Token-array batch sampling.
+
+Parity with the reference ``get_batch`` (cs336-basics/cs336_basics/data.py:
+10-30): random crops of a 1-D token array → (x, y = x shifted by one).
+
+TPU-first: the crop gather is vectorised (one fancy-index instead of a
+Python loop of per-sample copies) and the result is shipped to device with
+a single ``jax.device_put`` — the analogue of the reference's pinned-memory
+async H2D. An optional native C++ sampler (``cs336_systems_tpu.data.native``)
+does the same gather off the GIL for large batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def sample_batch_np(
+    dataset: np.ndarray,
+    batch_size: int,
+    context_length: int,
+    rng: np.random.Generator,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side crop sampling; returns int32 numpy (x, y) [B, ctx]."""
+    starts = rng.integers(0, len(dataset) - context_length, size=batch_size)
+    idx = starts[:, None] + np.arange(context_length + 1)[None, :]
+    window = dataset[idx].astype(np.int32)  # [B, ctx+1]
+    return np.ascontiguousarray(window[:, :-1]), np.ascontiguousarray(window[:, 1:])
+
+
+def get_batch(
+    dataset: np.ndarray,
+    batch_size: int,
+    context_length: int,
+    rng: np.random.Generator | int | None = None,
+    device=None,
+    sharding=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Sample a (x, y) LM batch and place it on device.
+
+    ``sharding`` (a ``jax.sharding.Sharding``) places the batch directly in
+    its distributed layout — the multi-chip replacement for per-rank
+    slicing. ``device`` pins to a single device.
+    """
+    if not isinstance(rng, np.random.Generator):
+        rng = np.random.default_rng(rng)
+    x, y = sample_batch_np(np.asarray(dataset), batch_size, context_length, rng)
+    target = sharding if sharding is not None else device
+    if target is not None:
+        return jax.device_put(x, target), jax.device_put(y, target)
+    return jnp.asarray(x), jnp.asarray(y)

@@ -1,0 +1,19 @@
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    MODEL_SIZES,
+    config_for_size,
+    init_transformer_lm,
+    transformer_lm,
+    count_params,
+    generate,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "MODEL_SIZES",
+    "config_for_size",
+    "init_transformer_lm",
+    "transformer_lm",
+    "count_params",
+    "generate",
+]

@@ -1,0 +1,130 @@
+"""Functional building blocks of the Transformer LM.
+
+Every layer is an ``init_*`` function producing a params pytree (dict of
+arrays) plus a pure apply function. Capability parity with the reference
+layer set (cs336-basics/cs336_basics/model.py):
+
+- Linear: bias-free, trunc-normal init std=sqrt(2/(din+dout)) clipped ±3σ
+  (model.py:22-44).
+- Embedding: trunc-normal std=1 clipped ±3 (model.py:47-60).
+- RMSNorm: eps 1e-5, learned scale, fp32 internal compute (model.py:63-110).
+- RoPE: interleaved-pair rotation from a precomputed cos/sin table
+  (model.py:113-150).
+- SwiGLU: w2(silu(w1 x) * w3 x) (model.py:389-397).
+
+TPU-first notes: weights are stored ``[d_out, d_in]`` and applied with an
+einsum that XLA maps straight onto the MXU; params live in ``param_dtype``
+(fp32 by default) and are cast to ``compute_dtype`` (bf16 for mixed
+precision) at use; RMSNorm always reduces in fp32.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def trunc_normal(key, shape, std: float, dtype=jnp.float32) -> jax.Array:
+    """Truncated normal with given std, clipped to ±3σ (matching torch's
+    ``trunc_normal_(std=s, a=-3s, b=3s)`` semantics)."""
+    return std * jax.random.truncated_normal(key, -3.0, 3.0, shape, jnp.float32).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Linear
+
+
+def init_linear(key, d_in: int, d_out: int, dtype=jnp.float32):
+    std = math.sqrt(2.0 / (d_in + d_out))
+    return {"weight": trunc_normal(key, (d_out, d_in), std, dtype)}
+
+
+def linear(params, x: jax.Array, compute_dtype=None) -> jax.Array:
+    w = params["weight"]
+    if compute_dtype is not None:
+        w = w.astype(compute_dtype)
+        x = x.astype(compute_dtype)
+    return jnp.einsum("...i,oi->...o", x, w)
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+
+
+def init_embedding(key, vocab_size: int, d_model: int, dtype=jnp.float32):
+    return {"weight": trunc_normal(key, (vocab_size, d_model), 1.0, dtype)}
+
+
+def embedding(params, token_ids: jax.Array, compute_dtype=None) -> jax.Array:
+    w = params["weight"]
+    if compute_dtype is not None:
+        w = w.astype(compute_dtype)
+    return jnp.take(w, token_ids, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm
+
+
+def init_rmsnorm(d_model: int, dtype=jnp.float32):
+    return {"weight": jnp.ones((d_model,), dtype)}
+
+
+def rmsnorm(params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMS layer norm; square/mean/rsqrt always in fp32, output in input dtype."""
+    in_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    return (params["weight"].astype(jnp.float32) * (xf * rms)).astype(in_dtype)
+
+
+# ---------------------------------------------------------------------------
+# RoPE (interleaved-pair convention, as in the reference RotaryEmbedding)
+
+
+def rope_cache(context_length: int, d_head: int, theta: float = 10000.0):
+    """Precompute cos/sin tables of shape [context_length, d_head // 2] (fp32)."""
+    assert d_head % 2 == 0
+    inv_freq = theta ** -(jnp.arange(0, d_head, 2, dtype=jnp.float32) / d_head)
+    t = jnp.arange(context_length, dtype=jnp.float32)
+    angles = jnp.outer(t, inv_freq)  # [ctx, d/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotate interleaved pairs (x[..., 2i], x[..., 2i+1]) by position angles.
+
+    ``x``: [..., seq, d_head]; ``positions``: int [seq] or broadcastable
+    [..., seq]. Rotation runs in fp32 and is cast back to x.dtype.
+    """
+    in_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x1 = xf[..., 0::2]
+    x2 = xf[..., 1::2]
+    c = jnp.take(cos, positions, axis=0)  # [..., seq, d/2]
+    s = jnp.take(sin, positions, axis=0)
+    r1 = c * x1 - s * x2
+    r2 = s * x1 + c * x2
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(in_dtype)
+
+
+# ---------------------------------------------------------------------------
+# SwiGLU feed-forward
+
+
+def init_swiglu(key, d_model: int, d_ff: int, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w1": init_linear(k1, d_model, d_ff, dtype),
+        "w2": init_linear(k2, d_ff, d_model, dtype),
+        "w3": init_linear(k3, d_model, d_ff, dtype),
+    }
+
+
+def swiglu(params, x: jax.Array, compute_dtype=None) -> jax.Array:
+    h = linear(params["w1"], x, compute_dtype)
+    g = linear(params["w3"], x, compute_dtype)
+    return linear(params["w2"], jax.nn.silu(h) * g, compute_dtype)

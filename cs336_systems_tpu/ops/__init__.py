@@ -1,0 +1,17 @@
+from cs336_systems_tpu.ops.nn import (
+    softmax,
+    log_softmax,
+    cross_entropy,
+    clip_gradients,
+    global_grad_norm,
+)
+from cs336_systems_tpu.ops.attention import scaled_dot_product_attention
+
+__all__ = [
+    "softmax",
+    "log_softmax",
+    "cross_entropy",
+    "clip_gradients",
+    "global_grad_norm",
+    "scaled_dot_product_attention",
+]

@@ -1,0 +1,70 @@
+"""Scaled dot-product attention (the "naive" XLA path) + LSE oracle.
+
+Parity with the reference ``scaled_dot_product_attention``
+(cs336-basics/cs336_basics/model.py:400-432): boolean mask with True=keep,
+-inf fill, softmax over keys. TPU-first details: scores accumulate in fp32
+on the MXU (``preferred_element_type``), softmax internals are fp32 even for
+bf16 inputs, and the whole function is a single fused XLA computation.
+
+``attention_with_lse`` additionally returns the per-row logsumexp — the test
+oracle contract used by the FlashAttention suite (reference
+tests/test_attention.py:11-26).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def scaled_dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """softmax(QK^T/sqrt(d) + mask) V with fp32 accumulation.
+
+    ``q``: [..., n_q, d], ``k``/``v``: [..., n_k, d]; ``mask`` boolean
+    [..., n_q, n_k] broadcastable, True = attend.
+    """
+    out, _ = attention_with_lse(q, k, v, mask)
+    return out
+
+
+def attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Attention plus per-query logsumexp (fp32), the FlashAttention oracle."""
+    in_dtype = q.dtype
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum(
+        "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # Guard fully-masked rows (all -inf): exp(-inf - -inf) would be NaN, and
+    # l would be 0. Such rows get out = 0 and lse = -inf.
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(scores - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    lse = (m + jnp.log(l))[..., 0]
+    p = jnp.where(l > 0.0, e / jnp.where(l > 0.0, l, 1.0), 0.0)
+    out = jnp.einsum(
+        "...qk,...kd->...qd", p.astype(in_dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(in_dtype), lse
+
+
+def causal_mask(n_q: int, n_k: int) -> jax.Array:
+    """Boolean [n_q, n_k] causal mask (True = attend), query i sees keys <= i."""
+    qi = jnp.arange(n_q)[:, None]
+    kj = jnp.arange(n_k)[None, :]
+    return qi >= kj

@@ -1,0 +1,65 @@
+"""Core numeric ops: softmax / log-softmax / cross-entropy / gradient clipping.
+
+Capability parity with the reference ``cs336_basics/nn_utils.py:4-30``
+(max-subtracted softmax, CE via gather on log-softmax, global-norm clip with
+eps 1e-6), re-designed for TPU: everything is jit-able, reductions run in
+fp32 regardless of input dtype (bf16-safe), and clipping operates on grad
+*pytrees* rather than mutating parameter objects in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Numerically-stable softmax (max-subtracted), fp32 internals."""
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x - jnp.max(x, axis=axis, keepdims=True)
+    e = jnp.exp(x)
+    return (e / jnp.sum(e, axis=axis, keepdims=True)).astype(in_dtype)
+
+
+def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Numerically-stable log-softmax, fp32 internals."""
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x - jnp.max(x, axis=axis, keepdims=True)
+    out = x - jnp.log(jnp.sum(jnp.exp(x), axis=axis, keepdims=True))
+    return out.astype(in_dtype)
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token-level cross-entropy.
+
+    ``logits``: ``[..., vocab]`` (any float dtype; loss computed in fp32).
+    ``targets``: integer ids ``[...]``.
+
+    Matches the reference semantics (gather of -log-softmax, global mean)
+    but uses ``take_along_axis`` — a TPU-friendly gather — instead of
+    materialising one-hots.
+    """
+    logits = logits.astype(jnp.float32)
+    nls = -log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(nls, targets[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(picked)
+
+
+def global_grad_norm(grads) -> jax.Array:
+    """Global L2 norm over a gradient pytree (fp32 accumulation)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
+def clip_gradients(grads, max_norm: float, eps: float = 1e-6):
+    """Global-norm gradient clipping on a pytree.
+
+    Scale = min(1, max_norm / (norm + eps)) — the reference's formulation
+    (nn_utils.py:21-30) — applied functionally (returns a new pytree).
+    """
+    norm = global_grad_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + eps))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)

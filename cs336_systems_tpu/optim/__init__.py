@@ -1,0 +1,4 @@
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
+from cs336_systems_tpu.optim.schedule import get_cosine_lr
+
+__all__ = ["AdamWHparams", "adamw_init", "adamw_update", "get_cosine_lr"]

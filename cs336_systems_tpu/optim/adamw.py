@@ -1,0 +1,78 @@
+"""From-scratch decoupled AdamW over parameter pytrees.
+
+Semantics parity with the reference hand-written optimizer
+(cs336-basics/cs336_basics/optimizer.py:30-86): per-param state {m, v},
+shared step count t (the reference stores t per-param but advances all in
+lockstep), bias correction folded into the step size
+``alpha_t = lr * sqrt(1 - beta2^t) / (1 - beta1^t)``, and decoupled weight
+decay ``p -= lr * wd * p`` applied *after* the Adam update.
+
+TPU-first: the update is one pure function over the whole pytree — a single
+fused XLA computation per step (no per-parameter Python loop on the hot
+path) — and moments/update math run in fp32 even for low-precision params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamWHparams:
+    lr: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+
+    def __post_init__(self):
+        if self.lr < 0.0:
+            raise ValueError(f"Invalid learning rate: {self.lr}")
+        if self.eps < 0.0:
+            raise ValueError(f"Invalid epsilon value: {self.eps}")
+        if not 0.0 <= self.beta1 < 1.0:
+            raise ValueError(f"Invalid beta parameter at index 0: {self.beta1}")
+        if not 0.0 <= self.beta2 < 1.0:
+            raise ValueError(f"Invalid beta parameter at index 1: {self.beta2}")
+
+
+def adamw_init(params):
+    """Optimizer state pytree: fp32 first/second moments + scalar step count."""
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    return {
+        "m": jax.tree_util.tree_map(zeros, params),
+        "v": jax.tree_util.tree_map(zeros, params),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def adamw_update(params, grads, state, hp: AdamWHparams, lr=None):
+    """One AdamW step. Returns (new_params, new_state).
+
+    ``lr`` (scalar, possibly traced — e.g. from a schedule) overrides
+    ``hp.lr`` so schedules don't force recompilation.
+    """
+    lr = hp.lr if lr is None else lr
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    b1, b2 = hp.beta1, hp.beta2
+    bias = jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+    alpha_t = lr * bias
+
+    def leaf(p, g, m, v):
+        gf = g.astype(jnp.float32)
+        m_t = b1 * m + (1.0 - b1) * gf
+        v_t = b2 * v + (1.0 - b2) * jnp.square(gf)
+        pf = p.astype(jnp.float32)
+        pf = pf - alpha_t * m_t / (jnp.sqrt(v_t) + hp.eps)
+        pf = pf - lr * hp.weight_decay * pf
+        return pf.astype(p.dtype), m_t, v_t
+
+    triples = jax.tree_util.tree_map(leaf, params, grads, state["m"], state["v"])
+    pick = lambda i: jax.tree_util.tree_map(
+        lambda t3: t3[i], triples, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    return pick(0), {"m": pick(1), "v": pick(2), "t": t}

@@ -1,0 +1,29 @@
+"""Learning-rate schedules.
+
+Parity with the reference warmup-cosine schedule
+(cs336-basics/cs336_basics/optimizer.py:9-27), written branch-free with
+``jnp.where`` so it can be traced inside a jitted train step (a traced
+step count must not drive Python control flow on TPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def get_cosine_lr(
+    it,
+    max_learning_rate: float,
+    min_learning_rate: float,
+    warmup_iters: int,
+    cosine_cycle_iters: int,
+):
+    """Linear warmup → cosine decay → floor. Works on ints and traced arrays."""
+    it = jnp.asarray(it, jnp.float32)
+    warmup = max_learning_rate * it / jnp.maximum(warmup_iters, 1)
+    decay_ratio = (it - warmup_iters) / jnp.maximum(cosine_cycle_iters - warmup_iters, 1)
+    decay_ratio = jnp.clip(decay_ratio, 0.0, 1.0)
+    coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * decay_ratio))
+    cosine = min_learning_rate + coeff * (max_learning_rate - min_learning_rate)
+    out = jnp.where(it < warmup_iters, warmup, cosine)
+    return jnp.where(it > cosine_cycle_iters, min_learning_rate, out)

@@ -1,0 +1,56 @@
+"""Single-device training step: the minimum end-to-end slice.
+
+The jitted step fuses forward, cross-entropy, backward, optional global-norm
+clipping, and the AdamW update into one XLA computation — the TPU-native
+equivalent of the reference's zero_grad/forward/loss/backward/step sequence
+(cs336_systems/benchmark.py:100-113), with no host round-trips between
+phases. Distributed variants live in ``cs336_systems_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
+
+
+def lm_loss(params, x, y, cfg: TransformerConfig):
+    logits = transformer_lm(params, x, cfg)
+    return cross_entropy(logits, y)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+) -> Callable:
+    """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``."""
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_eval_step(cfg: TransformerConfig) -> Callable:
+    return jax.jit(functools.partial(lm_loss, cfg=cfg))
+
+
+def init_train_state(key, cfg: TransformerConfig):
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    params = init_transformer_lm(key, cfg)
+    return params, adamw_init(params)

@@ -1,0 +1,3 @@
+from cs336_systems_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = ["save_checkpoint", "load_checkpoint"]

@@ -1,0 +1,80 @@
+"""Shared test fixtures: toy models as pytrees.
+
+Mirrors the reference fixtures (tests/common.py:24-68): ``ToyModel`` with a
+non-trainable bias and a frozen parameter, and ``ToyModelWithTiedWeights``
+where one weight is used by two layers.
+
+JAX translation of the edge cases:
+- *Frozen params* are expressed as a boolean ``trainable`` mask pytree; DP
+  sync and optimizers must leave masked-out leaves untouched.
+- *Tied weights* are one array referenced twice in the apply function —
+  ``jax.grad`` then delivers a single summed gradient for the shared leaf,
+  which the DP/ZeRO paths must keep consistent across replicas.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def toy_model_init(key):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    params = {
+        "fc1": {"weight": jax.random.normal(k1, (10, 10)) * 0.3},
+        "fc2": {
+            "weight": jax.random.normal(k2, (50, 10)) * 0.3,
+            "bias": jax.random.normal(k4, (50,)) * 0.1,  # frozen
+        },
+        "fc3": {"weight": jax.random.normal(k3, (5, 50)) * 0.3},
+        "no_grad_fixed_param": jnp.array([2.0, 2.0]),  # frozen
+    }
+    trainable = {
+        "fc1": {"weight": True},
+        "fc2": {"weight": True, "bias": False},
+        "fc3": {"weight": True},
+        "no_grad_fixed_param": False,
+    }
+    return params, trainable
+
+
+def toy_model_apply(params, x):
+    x = jax.nn.relu(x @ params["fc1"]["weight"].T)
+    x = jax.nn.relu(x @ params["fc2"]["weight"].T + params["fc2"]["bias"])
+    return x @ params["fc3"]["weight"].T
+
+
+def tied_model_init(key):
+    ks = jax.random.split(key, 4)
+    params = {
+        "fc1": {"weight": jax.random.normal(ks[0], (10, 10)) * 0.3},
+        "fc2": {"weight": jax.random.normal(ks[1], (50, 10)) * 0.3},  # also used as fc4
+        "fc3": {"weight": jax.random.normal(ks[2], (10, 50)) * 0.3},
+        "fc5": {"weight": jax.random.normal(ks[3], (5, 50)) * 0.3},
+    }
+    trainable = jax.tree_util.tree_map(lambda _: True, params)
+    return params, trainable
+
+
+def tied_model_apply(params, x):
+    w_tied = params["fc2"]["weight"]
+    x = jax.nn.relu(x @ params["fc1"]["weight"].T)
+    x = jax.nn.relu(x @ w_tied.T)
+    x = jax.nn.relu(x @ params["fc3"]["weight"].T)
+    x = jax.nn.relu(x @ w_tied.T)  # tied reuse (fc4.weight = fc2.weight)
+    return x @ params["fc5"]["weight"].T
+
+
+def mse_loss(apply_fn, params, x, y):
+    pred = apply_fn(params, x)
+    return jnp.mean(jnp.square(pred - y))
+
+
+def trees_allclose(a, b, rtol=1e-5, atol=1e-6) -> bool:
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    if len(leaves_a) != len(leaves_b):
+        return False
+    return all(
+        jnp.allclose(x, y, rtol=rtol, atol=atol) for x, y in zip(leaves_a, leaves_b)
+    )

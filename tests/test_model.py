@@ -1,0 +1,224 @@
+"""Model-library tests (L1): layers, LM forward, sampling, checkpointing."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs336_systems_tpu.models.layers import (
+    apply_rope,
+    init_linear,
+    init_rmsnorm,
+    linear,
+    rmsnorm,
+    rope_cache,
+)
+from cs336_systems_tpu.models.transformer import (
+    MODEL_SIZES,
+    TransformerConfig,
+    config_for_size,
+    count_params,
+    generate,
+    init_transformer_lm,
+    transformer_lm,
+)
+from cs336_systems_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=128,
+        context_length=64,
+        d_model=32,
+        num_layers=2,
+        num_heads=4,
+        d_ff=64,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_linear_init_stats():
+    key = jax.random.PRNGKey(0)
+    p = init_linear(key, 512, 512)
+    std = math.sqrt(2 / (512 + 512))
+    w = np.asarray(p["weight"])
+    assert abs(w.std() - std) / std < 0.1
+    assert np.abs(w).max() <= 3 * std + 1e-6
+    assert w.shape == (512, 512)
+
+
+def test_rmsnorm_fp32_internals_and_shape():
+    p = init_rmsnorm(16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16)).astype(jnp.bfloat16) * 100
+    out = rmsnorm(p, x)
+    assert out.dtype == jnp.bfloat16
+    # unit RMS after norm (weight=1)
+    rms = np.sqrt(np.mean(np.square(np.asarray(out, np.float32)), -1))
+    np.testing.assert_allclose(rms, 1.0, rtol=0.05)
+
+
+def test_rope_preserves_norm_and_zero_position_identity():
+    cos, sin = rope_cache(32, 8)
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 5, 8))
+    pos = jnp.arange(5)
+    out = apply_rope(x, cos, sin, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 has angle 0 → identity
+    np.testing.assert_allclose(np.asarray(out)[..., 0, :], np.asarray(x)[..., 0, :], rtol=1e-6)
+
+
+def test_rope_relative_position_property():
+    # Attention score q_i . k_j after RoPE must depend only on (i - j).
+    cos, sin = rope_cache(64, 16)
+    q = jax.random.normal(jax.random.PRNGKey(3), (16,))
+    k = jax.random.normal(jax.random.PRNGKey(4), (16,))
+
+    def score(i, j):
+        qr = apply_rope(q[None, None], cos, sin, jnp.array([i]))[0, 0]
+        kr = apply_rope(k[None, None], cos, sin, jnp.array([j]))[0, 0]
+        return float(qr @ kr)
+
+    assert abs(score(5, 3) - score(10, 8)) < 1e-4
+    assert abs(score(20, 0) - score(40, 20)) < 1e-4
+
+
+def test_lm_forward_shape_and_dtype():
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = transformer_lm(params, x, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_lm_causality():
+    """Changing a future token must not change logits at earlier positions."""
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, cfg.vocab_size)
+    logits_a = transformer_lm(params, x, cfg)
+    x2 = x.at[0, 10].set((x[0, 10] + 1) % cfg.vocab_size)
+    logits_b = transformer_lm(params, x2, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :10]), np.asarray(logits_b[0, :10]), rtol=1e-4, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits_a[0, 10]), np.asarray(logits_b[0, 10]))
+
+
+def test_lm_bf16_compute_close_to_fp32():
+    cfg = tiny_cfg()
+    cfg_bf16 = tiny_cfg(compute_dtype="bfloat16")
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    lf = transformer_lm(params, x, cfg)
+    lb = transformer_lm(params, x, cfg_bf16)
+    assert lb.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; logits should agree loosely
+    assert np.mean(np.abs(np.asarray(lf) - np.asarray(lb, np.float32))) < 0.15
+
+
+def test_remat_matches_no_remat():
+    cfg = tiny_cfg()
+    cfg_remat = tiny_cfg(remat=True)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+
+    def loss(p, c):
+        return jnp.mean(transformer_lm(p, x, c) ** 2)
+
+    g1 = jax.grad(loss)(params, cfg)
+    g2 = jax.grad(loss)(params, cfg_remat)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_count_params_analytic():
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.num_layers
+    per_block = 4 * d * d + 3 * d * f + 2 * d
+    expected_total = v * d + L * per_block + d + d * v
+    assert count_params(params, non_embedding=False) == expected_total
+    assert count_params(params, non_embedding=True) == expected_total - d * v
+
+
+def test_model_size_table():
+    assert set(MODEL_SIZES) == {"small", "medium", "large", "xl", "2.7b"}
+    cfg = config_for_size("small")
+    assert (cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.num_heads) == (768, 3072, 12, 12)
+
+
+def test_generate_shapes_eos_and_topk():
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.array([1, 2, 3])
+    out = generate(params, cfg, prompt, 5, jax.random.PRNGKey(7), temperature=0.8, top_k=10)
+    assert out.shape[0] <= 5
+    assert out.dtype == jnp.int32
+    assert np.all(np.asarray(out) >= 0) and np.all(np.asarray(out) < cfg.vocab_size)
+
+
+def test_generate_eos_stops_early():
+    """EOS must terminate sampling and must not be appended to the output.
+
+    top_k=1 makes sampling deterministic (argmax); running once without an
+    eos_token_id gives the greedy continuation, then designating its first
+    token as EOS must produce an empty output.
+    """
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.array([1, 2, 3])
+    free = generate(params, cfg, prompt, 4, jax.random.PRNGKey(3), top_k=1)
+    assert free.shape[0] == 4
+    first = int(free[0])
+    stopped = generate(
+        params, cfg, prompt, 4, jax.random.PRNGKey(3), top_k=1, eos_token_id=first
+    )
+    assert stopped.shape[0] == 0
+    # an EOS id that never wins argmax must not stop generation
+    other = (first + 1) % cfg.vocab_size
+    if other not in [int(t) for t in free]:
+        full = generate(
+            params, cfg, prompt, 4, jax.random.PRNGKey(3), top_k=1, eos_token_id=other
+        )
+        assert [int(t) for t in full] == [int(t) for t in free]
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        TransformerConfig(
+            vocab_size=32, context_length=16, d_model=65,
+            num_layers=1, num_heads=4, d_ff=64,
+        )
+    with pytest.raises(ValueError):
+        tiny_cfg(attn_impl="nope")
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    from cs336_systems_tpu.optim.adamw import adamw_init
+
+    opt_state = adamw_init(params)
+    save_checkpoint(str(tmp_path), params, config=cfg, opt_state=opt_state, step=42)
+    ck = load_checkpoint(str(tmp_path))
+    cfg2 = TransformerConfig.from_dict(ck["config"])
+    assert cfg2 == cfg
+    assert ck["step"] == 42
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(ck["params"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    x = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(transformer_lm(params, x, cfg)),
+        np.asarray(transformer_lm(ck["params"], x, cfg2)),
+        rtol=1e-6,
+    )

@@ -1,0 +1,135 @@
+"""AdamW + LR schedule + nn-utils tests against independent numpy oracles."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy, global_grad_norm, log_softmax, softmax
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
+from cs336_systems_tpu.optim.schedule import get_cosine_lr
+
+
+def numpy_adamw_reference(p, grads_seq, lr, b1, b2, eps, wd):
+    """Straight transcription of the reference update semantics
+    (optimizer.py:50-86) in numpy, used as the oracle."""
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t, g in enumerate(grads_seq, start=1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        alpha_t = lr * math.sqrt(1 - b2**t) / (1 - b1**t)
+        p = p - alpha_t * m / (np.sqrt(v) + eps)
+        p = p - lr * wd * p
+    return p
+
+
+def test_adamw_matches_reference_semantics():
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(7, 5)).astype(np.float32)
+    grads = [rng.normal(size=(7, 5)).astype(np.float32) for _ in range(10)]
+    hp = AdamWHparams(lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01)
+
+    params = {"w": jnp.asarray(p0)}
+    state = adamw_init(params)
+    for g in grads:
+        params, state = adamw_update(params, {"w": jnp.asarray(g)}, state, hp)
+
+    expected = numpy_adamw_reference(p0, grads, 1e-2, 0.9, 0.999, 1e-8, 0.01)
+    np.testing.assert_allclose(np.asarray(params["w"]), expected, rtol=1e-5, atol=1e-6)
+    assert int(state["t"]) == 10
+
+
+def test_adamw_under_jit_and_traced_lr():
+    hp = AdamWHparams()
+    params = {"a": jnp.ones((3,)), "b": {"c": jnp.full((2, 2), 2.0)}}
+    state = adamw_init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    @jax.jit
+    def step(p, s, lr):
+        return adamw_update(p, grads, s, hp, lr=lr)
+
+    p1, s1 = step(params, state, jnp.float32(0.1))
+    assert int(s1["t"]) == 1
+    assert not np.allclose(np.asarray(p1["a"]), np.asarray(params["a"]))
+
+
+def test_cosine_lr_schedule():
+    mx, mn, warm, total = 1.0, 0.1, 10, 100
+    # warmup is linear
+    assert math.isclose(float(get_cosine_lr(0, mx, mn, warm, total)), 0.0)
+    assert math.isclose(float(get_cosine_lr(5, mx, mn, warm, total)), 0.5, rel_tol=1e-6)
+    # peak at end of warmup
+    assert math.isclose(float(get_cosine_lr(10, mx, mn, warm, total)), mx, rel_tol=1e-6)
+    # midpoint of cosine: average of max and min
+    assert math.isclose(float(get_cosine_lr(55, mx, mn, warm, total)), (mx + mn) / 2, rel_tol=1e-5)
+    # floor after the cycle
+    assert math.isclose(float(get_cosine_lr(150, mx, mn, warm, total)), mn, rel_tol=1e-6)
+    # traceable
+    vals = jax.vmap(lambda i: get_cosine_lr(i, mx, mn, warm, total))(jnp.arange(200))
+    assert vals.shape == (200,)
+
+
+def test_softmax_and_log_softmax():
+    x = jnp.array([[1e4, 1e4 + 1.0, 0.0]])  # overflow-prone without max-subtract
+    s = np.asarray(softmax(x))
+    assert np.all(np.isfinite(s))
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-6)
+    ls = np.asarray(log_softmax(x))
+    np.testing.assert_allclose(np.exp(ls), s, rtol=1e-5, atol=1e-7)
+
+
+def test_cross_entropy_matches_manual():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(4, 9, 11)).astype(np.float32)
+    targets = rng.integers(0, 11, size=(4, 9))
+    # manual
+    x = logits - logits.max(-1, keepdims=True)
+    logp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    expected = -np.take_along_axis(logp, targets[..., None], -1).mean()
+    got = float(cross_entropy(jnp.asarray(logits), jnp.asarray(targets)))
+    assert math.isclose(got, float(expected), rel_tol=1e-5)
+
+
+def test_gradient_clipping():
+    grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
+    norm = float(global_grad_norm(grads))
+    assert math.isclose(norm, math.sqrt(10 * 9 + 10 * 16), rel_tol=1e-6)
+    clipped = clip_gradients(grads, max_norm=1.0)
+    new_norm = float(global_grad_norm(clipped))
+    assert math.isclose(new_norm, 1.0, rel_tol=1e-4)
+    # below threshold: untouched
+    small = {"a": jnp.full((4,), 0.01)}
+    same = clip_gradients(small, max_norm=1.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), np.asarray(small["a"]), rtol=1e-7)
+
+
+def test_data_loader():
+    from cs336_systems_tpu.data.loader import get_batch
+
+    dataset = np.arange(1000, dtype=np.uint16)
+    x, y = get_batch(dataset, batch_size=8, context_length=32, rng=0)
+    assert x.shape == (8, 32) and y.shape == (8, 32)
+    # y is x shifted by one
+    np.testing.assert_array_equal(np.asarray(x)[:, 1:], np.asarray(y)[:, :-1])
+    np.testing.assert_array_equal(np.asarray(y)[:, 0], np.asarray(x)[:, 0] + 1)
+
+
+def test_train_step_reduces_loss():
+    from cs336_systems_tpu.train import init_train_state, make_train_step
+    from cs336_systems_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, context_length=32, d_model=32, num_layers=2, num_heads=4, d_ff=64
+    )
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg, AdamWHparams(lr=3e-3))
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
