@@ -1,0 +1,376 @@
+"""FlashAttention-2 for TPU: online-softmax attention with O(S) memory.
+
+Capability parity with the reference's three-part FlashAttention surface
+(cs336_systems/flash_attention.py):
+
+- ``FlashAttentionTorch`` (pure tiled loop, flash_attention.py:8-83)
+  → ``_flash_fwd_reference``: jax.numpy + ``lax.scan`` over K/V tiles;
+  runs on any backend.
+- ``FlashAttentionTriton`` + ``flash_attention_kernel`` (Triton GPU kernel,
+  flash_attention.py:85-266) → ``_flash_fwd_pallas``: a Pallas (Mosaic) TPU
+  kernel. NOT a translation: the Triton kernel holds one q-tile per program
+  and loops K/V inside; here the grid is (batch, q-tile, k-tile) with the
+  k axis innermost, VMEM scratch carrying the online-softmax state between
+  k steps, so K/V stream through VMEM and sequence length is bounded by HBM,
+  not VMEM. Tiles are MXU-aligned (128) instead of the reference's 16.
+- ``backward_pass_recomp`` under ``torch.compile`` (flash_attention.py:270-289)
+  → an XLA-jitted recompute backward wired through ``jax.custom_vjp``:
+  recomputes P from the saved logsumexp, D = rowsum(O ∘ dO), then
+  dV = PᵀdO, dS = P ∘ (dP − D), dQ = dS·K/√d, dK = dSᵀ·Q/√d. Like the
+  reference, this backward materializes the full [B, n_q, n_k] matrix —
+  O(S) memory holds for the forward only; a tiled Pallas backward is the
+  planned upgrade for long-sequence training.
+
+Contracts shared with the reference (tests/test_attention.py):
+- forward saves exactly (Q, K, V, O, L) where L = m + log l is the per-row
+  logsumexp, shape [batch, n_queries];
+- numerics match the plain-attention oracle at rtol/atol 1e-2.
+
+All matmuls use ``preferred_element_type=float32`` (fp32 MXU accumulation
+over bf16 inputs); softmax state (m, l) is fp32 throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_Q_TILE = 128
+DEFAULT_K_TILE = 128
+_NEG_INF = -1e30  # finite fill: exp(_NEG_INF - m) == 0 without NaN risk
+
+
+def _pick_tile(n: int, want: int) -> int:
+    """Largest power-of-two tile <= want that keeps one full tile <= n."""
+    t = want
+    while t > n and t > 8:
+        t //= 2
+    return t
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    n = x.shape[axis]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# Portable reference forward: lax.scan over K/V tiles (online softmax)
+
+
+def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int):
+    """Tiled online-softmax forward. q/k/v: [B, S, D] → (O [B,S,D], L [B,S]).
+
+    The scan body is the same per-tile update as the reference inner loop
+    (flash_attention.py:44-63): running max m, running denominator l,
+    rescale-accumulate O; epilogue O/l and L = m + log l.
+    """
+    in_dtype = q.dtype
+    b, n_q, d = q.shape
+    n_k = k.shape[1]
+    bq = _pick_tile(n_q, q_tile)
+    bk = _pick_tile(n_k, k_tile)
+    scale = 1.0 / math.sqrt(d)
+
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bk)
+    vp = _pad_to(v, 1, bk)
+    tq, tk = qp.shape[1] // bq, kp.shape[1] // bk
+
+    qf = qp.reshape(b, tq, bq, d)
+    kf = kp.reshape(b, tk, bk, d)
+    vf = vp.reshape(b, tk, bk, d)
+
+    q_pos = jnp.arange(tq * bq).reshape(tq, bq)  # global query positions
+    k_pos = jnp.arange(tk * bk).reshape(tk, bk)  # global key positions
+
+    def q_block(q_blk, qpos_blk):
+        def kv_step(carry, inputs):
+            m, l, acc = carry
+            k_blk, v_blk, kpos_blk = inputs
+            s = (
+                jnp.einsum(
+                    "bqd,bkd->bqk", q_blk, k_blk, preferred_element_type=jnp.float32
+                )
+                * scale
+            )
+            valid = kpos_blk[None, :] < n_k  # mask K padding
+            if causal:
+                valid = valid & (qpos_blk[:, None] >= kpos_blk[None, :])
+            s = jnp.where(valid[None, :, :], s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jnp.einsum(
+                "bqk,bkd->bqd", p.astype(in_dtype), v_blk,
+                preferred_element_type=jnp.float32,
+            )
+            return (m_new, l, acc), None
+
+        m0 = jnp.full((b, bq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, bq), jnp.float32)
+        a0 = jnp.zeros((b, bq, d), jnp.float32)
+        (m, l, acc), _ = jax.lax.scan(
+            kv_step, (m0, l0, a0), (kf.swapaxes(0, 1), vf.swapaxes(0, 1), k_pos)
+        )
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o = acc / safe_l[..., None]
+        lse = m + jnp.log(safe_l)
+        return o, lse
+
+    o, lse = jax.vmap(q_block, in_axes=(1, 0), out_axes=(1, 1))(qf, q_pos)
+    o = o.reshape(b, tq * bq, d)[:, :n_q].astype(in_dtype)
+    lse = lse.reshape(b, tq * bq)[:, :n_q]
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel forward
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, n_k: int, bq: int, bk: int,
+                  n_k_tiles: int):
+    """One (batch, q-tile, k-tile) grid step of the online-softmax forward.
+
+    The k axis is the innermost grid dimension; Mosaic runs grid steps
+    sequentially per core, so the fp32 VMEM scratch (m, l, acc) carries the
+    running softmax state across k steps for the current q tile.
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * bq
+    k_start = kj * bk
+
+    # Causal: a k tile strictly right of the q tile's last row is all-masked.
+    needed = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        s = (
+            jax.lax.dot_general(
+                q_ref[0],
+                k_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [bq, bk]
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = kpos < n_k  # K-padding mask
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            valid = valid & (qpos >= kpos)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0:1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        p = jnp.exp(s - m_new)  # [bq, bk] fp32
+        l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == n_k_tiles - 1)
+    def _epilogue():
+        l = l_ref[:, 0:1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0:1] + jnp.log(safe_l))[:, 0]
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
+                      interpret: bool | None = None):
+    """Host launch of the Pallas forward. q/k/v: [B, S, D] → (O, L)."""
+    in_dtype = q.dtype
+    b, n_q, d = q.shape
+    n_k = k.shape[1]
+    bq = _pick_tile(n_q, q_tile)
+    bk = _pick_tile(n_k, k_tile)
+
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bk)
+    vp = _pad_to(v, 1, bk)
+    sq, sk = qp.shape[1], kp.shape[1]
+    tq, tk = sq // bq, sk // bk
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=1.0 / math.sqrt(d),
+        causal=causal,
+        n_k=n_k,
+        bq=bq,
+        bk=bk,
+        n_k_tiles=tk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, tq, tk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bi, qi, kj: (bi, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bi, qi, kj: (bi, kj, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bi, qi, kj: (bi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, d), in_dtype),
+            jax.ShapeDtypeStruct((b, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :n_q], lse[:, :n_q]
+
+
+# ---------------------------------------------------------------------------
+# Backward: recompute from the saved logsumexp (XLA-fused)
+
+
+def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool):
+    """Recompute-P backward (reference backward_pass_recomp,
+    flash_attention.py:270-287), one fused XLA computation.
+
+    P = exp(QKᵀ/√d − L); D = rowsum(O ∘ dO);
+    dV = PᵀdO; dP = dO Vᵀ; dS = P ∘ (dP − D); dQ = dS K/√d; dK = dSᵀQ/√d.
+    """
+    in_dtype = q.dtype
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        n_q, n_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(n_q)[:, None] >= jnp.arange(n_k)[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # [b, nq, nk] fp32
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(o.astype(jnp.float32) * dof, axis=-1)  # D: [b, nq]
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32),
+                    preferred_element_type=jnp.float32) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32),
+                    preferred_element_type=jnp.float32) * scale
+    return dq.astype(in_dtype), dk.astype(in_dtype), dv.astype(in_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+
+
+def _flash_forward(q, k, v, causal, impl, q_tile, k_tile):
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+    if impl == "pallas":
+        return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile)
+    elif impl == "reference":
+        return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile)
+    raise ValueError(f"unknown flash impl: {impl!r}")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, impl, q_tile, k_tile):
+    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile)
+
+
+def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile):
+    o, lse = _flash_forward(q, k, v, causal, impl, q_tile, k_tile)
+    # Residuals mirror the reference contract: exactly (Q, K, V, O, L) with
+    # L = logsumexp of shape [batch, n_queries] (flash_attention.py:66-70).
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, impl, q_tile, k_tile, res, cotangents):
+    q, k, v, o, lse = res
+    # LSE is a saved softmax statistic, not a differentiable output (parity:
+    # the reference backward receives only dO); its cotangent is discarded.
+    do, _ = cotangents
+    return _flash_bwd_recompute(q, k, v, o, lse, do, causal)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _folded_call(q, k, v, causal, impl, q_tile, k_tile):
+    """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash."""
+    squeeze = q.ndim == 2
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    lead = q.shape[:-2]
+    fold = lambda x: x.reshape((-1,) + x.shape[-2:])
+    o, lse = _flash(fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile)
+    o = o.reshape(lead + o.shape[-2:])
+    lse = lse.reshape(lead + lse.shape[-1:])
+    if squeeze:
+        o, lse = o[0], lse[0]
+    return o, lse
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    impl: str = "auto",
+    q_tile: int = DEFAULT_Q_TILE,
+    k_tile: int = DEFAULT_K_TILE,
+) -> jax.Array:
+    """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
+
+    ``impl``: "pallas" (TPU kernel; interpreter on CPU), "reference"
+    (portable lax.scan tiling), or "auto" (pallas on TPU else reference).
+    Leading batch dims are folded; 2-D inputs get a singleton batch like the
+    reference host side (flash_attention.py:92-99).
+    """
+    return _folded_call(q, k, v, causal, impl, q_tile, k_tile)[0]
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    impl: str = "auto",
+    q_tile: int = DEFAULT_Q_TILE,
+    k_tile: int = DEFAULT_K_TILE,
+) -> tuple[jax.Array, jax.Array]:
+    """Forward returning (O, logsumexp [..., n_q] fp32) — the saved-residual
+    contract (reference test digs L out of saved_tensors, test_attention.py:
+    48-51). Differentiable in O through the same recompute backward as
+    ``flash_attention``; accepts the same [..., S, D] shapes."""
+    return _folded_call(q, k, v, causal, impl, q_tile, k_tile)
