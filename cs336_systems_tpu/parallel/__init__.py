@@ -1,0 +1,20 @@
+"""Device-mesh parallelism layer.
+
+The TPU-native replacement for the reference's torch.distributed stack
+(NCCL/Gloo process groups, mp.spawn, DDP wrapper classes, ZeRO-1 optimizer —
+cs336_systems/naive_ddp.py, ddp_bucketed_overlapped_sharded.py,
+distributed_communication_single.py):
+
+- ``mesh``        — one Mesh/axis abstraction over ICI (and DCN multi-host).
+- ``collectives`` — rank-0 broadcast + the all-reduce latency/bandwidth
+                    microbenchmark (raw psum/all_gather/ppermute are used
+                    directly via jax.lax inside shard_map).
+- ``dp``          — data-parallel train steps in three collective-granularity
+                    variants (naive per-param, flat single-tensor, bucketed).
+- ``zero``        — ZeRO-1: optimizer state sharded over the dp axis.
+
+Everything is single-program SPMD under ``jax.shard_map``: one jitted step
+per variant, collectives scheduled (and overlapped with compute) by XLA.
+"""
+
+from cs336_systems_tpu.parallel.mesh import make_mesh  # noqa: F401

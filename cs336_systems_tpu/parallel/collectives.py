@@ -1,0 +1,120 @@
+"""Collective wrappers + the all-reduce microbenchmark.
+
+Primitive parity with the reference's torch.distributed usage (SURVEY §5):
+broadcast → ``broadcast_from_rank0``; all_reduce(SUM)/avg → ``psum``/
+``pmean`` inside the jitted step; all_gather → ``jax.lax.all_gather``;
+reduce_scatter (ZeRO) → ``jax.lax.psum_scatter``. There is no barrier —
+XLA programs are data-flow-ordered, and ``block_until_ready`` is the host
+fence (the analogue of ``torch.cuda.synchronize``).
+
+The microbenchmark mirrors distributed_communication_single.py:28-109:
+{1,10,100} MB fp32 payloads, warmup + timed iterations, per-size mean/std
+latency — plus algorithmic bus bandwidth, which the reference leaves to the
+reader.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def broadcast_from_rank0(tree, mesh: Mesh, axis: str = "dp"):
+    """Select device 0's copy of every leaf and replicate it over ``axis``.
+
+    Semantics parity with the reference's param broadcast at DDP wrap
+    (naive_ddp.py:297-308, ddp_bucketed_overlapped_sharded.py:260-261).
+    ``tree`` leaves carry a leading ``axis``-sized per-device dim (each
+    device's own copy); the result drops it, returning rank-0's values
+    replicated everywhere.
+    """
+
+    def pick(stacked):
+        def inner(x):
+            # x: [1, ...] local slice; psum of (rank0 ? x : 0) = rank0's x.
+            rank = jax.lax.axis_index(axis)
+            contrib = jnp.where(rank == 0, x[0], jnp.zeros_like(x[0]))
+            return jax.lax.psum(contrib, axis)
+
+        return jax.tree_util.tree_map(inner, stacked)
+
+    spec_in = jax.tree_util.tree_map(lambda _: P(axis), tree)
+    spec_out = jax.tree_util.tree_map(lambda _: P(), tree)
+    return jax.jit(
+        jax.shard_map(pick, mesh=mesh, in_specs=(spec_in,), out_specs=spec_out)
+    )(tree)
+
+
+@dataclass
+class AllReduceResult:
+    world_size: int
+    payload_mb: float
+    mean_ms: float
+    std_ms: float
+    bus_gbps: float  # algorithmic all-reduce bus bandwidth 2(n-1)/n * bytes/t
+
+
+def benchmark_allreduce(
+    mesh: Mesh,
+    payload_mbs=(1.0, 10.0, 100.0),
+    warmup: int = 5,
+    iters: int = 3,
+    axis: str = "dp",
+) -> list[AllReduceResult]:
+    """Time ``psum`` over ``axis`` for fp32 payloads of the given sizes."""
+    n = mesh.shape[axis]
+    results = []
+    for mb in payload_mbs:
+        numel = int(mb * 1024 * 1024 / 4)
+
+        def allreduce(x):
+            return jax.lax.psum(x, axis)
+
+        fn = jax.jit(
+            jax.shard_map(allreduce, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis))
+        )
+        # per-device contribution: numel elements each (payload = per-rank
+        # tensor size, matching the reference's per-rank MB definition)
+        x = jax.device_put(
+            np.random.default_rng(0).standard_normal(n * numel).astype(np.float32),
+            NamedSharding(mesh, P(axis)),
+        )
+        for _ in range(warmup):
+            fn(x).block_until_ready()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        mean_s = float(np.mean(times))
+        bytes_ = numel * 4
+        bus = 2 * (n - 1) / n * bytes_ / mean_s / 1e9
+        results.append(
+            AllReduceResult(n, mb, mean_s * 1e3, float(np.std(times)) * 1e3, bus)
+        )
+    return results
+
+
+def format_allreduce_table(results: list[AllReduceResult]) -> str:
+    lines = [
+        f"{'world':>5} {'MB':>8} {'mean_ms':>10} {'std_ms':>9} {'bus_GB/s':>9}",
+    ]
+    for r in results:
+        lines.append(
+            f"{r.world_size:>5} {r.payload_mb:>8.1f} {r.mean_ms:>10.3f} "
+            f"{r.std_ms:>9.3f} {r.bus_gbps:>9.2f}"
+        )
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    res = benchmark_allreduce(mesh)
+    print(format_allreduce_table(res))

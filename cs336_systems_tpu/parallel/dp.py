@@ -1,0 +1,229 @@
+"""Data-parallel training steps — the four reference DP maturity stages.
+
+Reference capability map (SURVEY §2.3 → here):
+
+- DP v0 "naive" (naive_ddp.py:269-442): per-parameter blocking all-reduce
+  → ``variant="naive"``: one ``pmean`` per gradient leaf.
+- DP v1 "flat" (naive_ddp.py:444-634): flatten everything, one all-reduce
+  → ``variant="flat"``: single concatenated ``pmean``.
+- DP v2 overlap (DDP, ddp_bucketed_overlapped_sharded.py:217-248): async
+  per-param all-reduce fired from autograd hooks → in XLA the *same
+  structure* as "naive": each leaf's ``pmean`` is an independent collective
+  that the compiler's latency-hiding scheduler overlaps with remaining
+  backward compute. Hook plumbing, handle bookkeeping and
+  ``finish_gradient_synchronization`` have no equivalent — they are the
+  scheduler's job.
+- DP v3 bucketed (DDP_Bucketed, ddp_bucketed_overlapped_sharded.py:251-318):
+  reverse-order ≤bucket_size_mb buckets, one async all-reduce per bucket
+  → ``variant="bucketed"``: reverse-order greedy buckets, one concatenated
+  ``pmean`` per bucket — explicit collective-granularity control.
+
+Broadcast-at-wrap semantics (rank-0 params to all) are
+``collectives.broadcast_from_rank0``. Frozen parameters (reference ToyModel
+with requires_grad=False, tests/common.py:24-48) are a boolean
+``trainable`` mask pytree: masked leaves sync no gradient and take no
+update. Tied weights are a single pytree leaf used twice in apply — autodiff
+delivers one summed gradient, so every variant keeps replicas consistent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cs336_systems_tpu.models.transformer import TransformerConfig
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+
+VARIANTS = ("naive", "flat", "bucketed")
+
+
+def local_value_and_grad(loss_fn: Callable, axis: str = "dp") -> Callable:
+    """``value_and_grad`` producing *rank-local* (unsynchronised) gradients
+    inside ``shard_map``.
+
+    JAX's manual-mode AD auto-inserts a ``psum`` for gradients of
+    axis-invariant (replicated) parameters — i.e. plain ``jax.grad`` inside
+    ``shard_map`` returns gradients that are already summed over ``axis``,
+    the analogue of DDP doing the all-reduce for you. To *own* the
+    communication (which is the entire point of the DP variants), the
+    params are first cast to axis-varying, making the gradient local; the
+    caller then synchronises explicitly via ``sync_grads``.
+    """
+
+    def fn(params, *batch):
+        varying = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, axis, to="varying"), params
+        )
+        return jax.value_and_grad(loss_fn)(varying, *batch)
+
+    return fn
+
+
+def assign_buckets(leaves, bucket_size_mb: float) -> list[list[int]]:
+    """Greedy reverse-order bucketing by byte size.
+
+    Mirrors DDP_Bucketed's bucket build (ddp_bucketed_overlapped_sharded.py:
+    263-282): walk leaves in *reverse* order (backward-completion order in
+    the reference), open a new bucket whenever adding a leaf would exceed
+    ``bucket_size_mb``. Returns lists of leaf indices.
+    """
+    limit = bucket_size_mb * 1024 * 1024
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for idx in reversed(range(len(leaves))):
+        nbytes = leaves[idx].size * leaves[idx].dtype.itemsize
+        if cur and cur_bytes + nbytes > limit:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(idx)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def sync_grads(
+    grads,
+    axis: str = "dp",
+    variant: str = "bucketed",
+    bucket_size_mb: float = 1000.0,
+    trainable=None,
+):
+    """Average a gradient pytree across ``axis`` (call inside shard_map).
+
+    ``variant`` controls collective granularity (see module docstring).
+    ``trainable``: optional boolean mask pytree; masked-out leaves are
+    excluded from communication entirely (parity: frozen params never
+    registered for reduction) and returned as zeros.
+    """
+    if variant not in VARIANTS:
+        raise ValueError(f"unknown DP variant {variant!r}; pick from {VARIANTS}")
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if trainable is not None:
+        tmask = treedef.flatten_up_to(trainable)
+    else:
+        tmask = [True] * len(leaves)
+    active = [i for i, t in enumerate(tmask) if t]
+
+    def put_back(synced: dict):
+        # frozen leaves: fresh zero constants (NOT zeros_like, which would
+        # inherit the axis-varying type of the local gradient and violate
+        # the replicated out_spec)
+        out = [
+            synced[i] if i in synced else jnp.zeros(leaves[i].shape, leaves[i].dtype)
+            for i in range(len(leaves))
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    if variant == "naive":
+        return put_back({i: jax.lax.pmean(leaves[i], axis) for i in active})
+
+    # flat/bucketed concatenate raveled leaves; group by dtype first so bf16
+    # gradients are not silently promoted (and shipped) as fp32.
+    by_dtype: dict = {}
+    for i in active:
+        by_dtype.setdefault(leaves[i].dtype, []).append(i)
+
+    groups: list[list[int]] = []
+    for idxs in by_dtype.values():
+        if variant == "flat":
+            groups.append(idxs)
+        else:  # bucketed
+            groups.extend(
+                [idxs[j] for j in bucket]
+                for bucket in assign_buckets([leaves[i] for i in idxs], bucket_size_mb)
+            )
+
+    synced: dict = {}
+    for group in groups:
+        flat = jnp.concatenate([leaves[i].ravel() for i in group])
+        flat = jax.lax.pmean(flat, axis)
+        offset = 0
+        for i in group:
+            n = leaves[i].size
+            synced[i] = flat[offset : offset + n].reshape(leaves[i].shape)
+            offset += n
+    return put_back(synced)
+
+
+def make_dp_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    variant: str = "bucketed",
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    bucket_size_mb: float = 1000.0,
+    axis: str = "dp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted DP LM train step over ``mesh[axis]``.
+
+    Params/optimizer state replicated; x/y batch-sharded over ``axis``.
+    Unlike the reference (which clips per-rank grads *before* the
+    all-reduce, naive_ddp.py:352-364), clipping runs on the *averaged*
+    gradient so DP training is step-equivalent to large-batch single-device
+    training.
+    """
+    from cs336_systems_tpu.train import lm_loss
+
+    def local_step(params, opt_state, x, y):
+        vag = local_value_and_grad(lambda p, xx, yy: lm_loss(p, xx, yy, cfg), axis)
+        loss, grads = vag(params, x, y)
+        grads = sync_grads(grads, axis, variant, bucket_size_mb)
+        loss = jax.lax.pmean(loss, axis)
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        return params, opt_state, loss
+
+    step = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_dp_grad_fn(
+    loss_fn: Callable,
+    mesh: Mesh,
+    variant: str = "naive",
+    bucket_size_mb: float = 1000.0,
+    axis: str = "dp",
+    trainable=None,
+) -> Callable:
+    """Generic DP gradient function for arbitrary models (the toy-model /
+    DDP-equivalence test seam): ``(params, *batch) -> (loss, synced_grads)``
+    with the batch sharded over ``axis``.
+    """
+
+    def local(params, *batch):
+        loss, grads = local_value_and_grad(loss_fn, axis)(params, *batch)
+        grads = sync_grads(grads, axis, variant, bucket_size_mb, trainable)
+        return jax.lax.pmean(loss, axis), grads
+
+    compiled: dict[int, Callable] = {}  # batch arity -> jitted step (built once)
+
+    def wrapper(params, *batch):
+        fn = compiled.get(len(batch))
+        if fn is None:
+            fn = compiled[len(batch)] = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(P(),) + (P(axis),) * len(batch),
+                    out_specs=(P(), P()),
+                )
+            )
+        return fn(params, *batch)
+
+    return wrapper

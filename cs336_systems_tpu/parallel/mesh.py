@@ -1,0 +1,76 @@
+"""Mesh construction and sharding helpers.
+
+Replaces the reference's process-group bootstrap (``setup`` /
+``_setup_process_group`` — naive_ddp.py:35-51, tests/common.py:71-88:
+MASTER_ADDR, fixed ports, init_process_group, per-rank device pinning) with
+a single declarative ``jax.sharding.Mesh``. Rendezvous, rank assignment and
+transport selection (ICI within a slice, DCN across hosts) are handled by
+the runtime; multi-host runs call ``jax.distributed.initialize`` once and
+build the same mesh over ``jax.devices()``.
+
+Axis conventions (room for every parallelism strategy; the reference uses
+only DP):
+
+- ``dp``: data parallel — batch sharded, params replicated.
+- ``tp``: tensor parallel — attention heads / FFN hidden sharded.
+- ``sp``: sequence/context parallel — sequence axis sharded (ring attention).
+- ``pp``: pipeline parallel — layer groups sharded.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp", "sp", "pp")
+
+
+def make_mesh(
+    axes: dict[str, int] | int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes``: mapping axis-name → size (e.g. ``{"dp": 2, "tp": 4}``), an int
+    (shorthand for ``{"dp": n}``), or None (all devices on ``dp``). Sizes
+    must multiply to the device count used. ``devices``: explicit device
+    list (defaults to ``jax.devices()``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    elif isinstance(axes, int):
+        axes = {"dp": axes}
+    axes = OrderedDict(axes)
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh {dict(axes)} needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding that replicates an array across the whole mesh."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def shard_batch(mesh: Mesh, *arrays, axis: str = "dp"):
+    """Place host arrays with the batch dim sharded over ``axis`` — the
+    replacement for the reference's per-rank batch slicing
+    (naive_ddp.py:315-330)."""
+    sh = batch_sharding(mesh, axis)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out[0] if len(out) == 1 else out

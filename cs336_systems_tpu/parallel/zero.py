@@ -1,0 +1,193 @@
+"""ZeRO-1: AdamW with optimizer state sharded over the data-parallel axis.
+
+Reference capability (ShardedStateOptimizer,
+ddp_bucketed_overlapped_sharded.py:322-362): greedy byte-balanced
+param→rank assignment; each rank runs the inner optimizer over its owned
+params only; ``step()`` broadcasts owner-updated params to everyone.
+
+TPU-native re-design (NOT a port of the owner-computes class): the state is
+*index-sharded*. All parameters are flattened into one vector, padded to a
+multiple of the world size, and each device owns one contiguous chunk:
+
+    grads --reduce-scatter--> my chunk (summed)   [jax.lax.psum_scatter]
+    AdamW update on my chunk with my (m, v) chunk  [1/N state memory]
+    updated chunks --all-gather--> full flat params
+
+This is bit-faithful to unsharded AdamW (the update is elementwise, so
+chunking cannot change any value) — satisfying the reference test's tight
+``assert_allclose`` bar (test_sharded_optimizer.py:80-84) — while the
+reduce-scatter + all-gather pair rides the ICI ring at full bus bandwidth.
+
+``greedy_param_assignment`` reproduces the reference's byte-balanced
+assignment policy (np.argmin over rank byte totals, lines 342-362) for
+parity and for the param-granular sharding mode some frameworks prefer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cs336_systems_tpu.models.transformer import TransformerConfig
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+
+
+def greedy_param_assignment(params, world_size: int) -> list[int]:
+    """Byte-balanced leaf→rank assignment: each leaf (in pytree order) goes
+    to the currently-lightest rank. Returns rank per leaf."""
+    sizes = [
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree_util.tree_leaves(params)
+    ]
+    rank_bytes = np.zeros(world_size, np.int64)
+    owners = []
+    for nbytes in sizes:
+        r = int(np.argmin(rank_bytes))
+        owners.append(r)
+        rank_bytes[r] += nbytes
+    return owners
+
+
+def _flat_size(params) -> int:
+    return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
+
+
+def _chunk(n: int, world: int) -> int:
+    return -(-n // world)  # ceil
+
+
+def zero1_init(params, mesh: Mesh, axis: str = "dp"):
+    """Sharded optimizer state: fp32 m/v of shape [world, chunk], each row
+    physically resident on one device (NamedSharding over ``axis``)."""
+    world = mesh.shape[axis]
+    chunk = _chunk(_flat_size(params), world)
+    sh = NamedSharding(mesh, P(axis))
+    zeros = jnp.zeros((world, chunk), jnp.float32)
+    return {
+        "m": jax.device_put(zeros, sh),
+        "v": jax.device_put(zeros, sh),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def zero1_state_bytes(params, world: int) -> int:
+    """Per-device optimizer state footprint (vs 8 bytes/param unsharded)."""
+    return 2 * 4 * _chunk(_flat_size(params), world)
+
+
+def make_zero1_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    axis: str = "dp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted DP + ZeRO-1 LM train step: ``(params, zstate, x, y) ->
+    (params, zstate, loss)`` with x/y sharded over ``axis``."""
+    from cs336_systems_tpu.train import lm_loss
+
+    def loss_fn(params, x, y):
+        return lm_loss(params, x, y, cfg)
+
+    return _build_zero1_step(loss_fn, hp, mesh, clip_norm, lr_schedule, axis, donate)
+
+
+def _build_zero1_step(
+    loss_fn: Callable,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None,
+    lr_schedule: Callable | None,
+    axis: str,
+    donate: bool,
+) -> Callable:
+    world = mesh.shape[axis]
+
+    def local_step(params, zstate, *batch):
+        from cs336_systems_tpu.parallel.dp import local_value_and_grad
+
+        loss, grads = local_value_and_grad(loss_fn, axis)(params, *batch)
+        loss = jax.lax.pmean(loss, axis)
+
+        flat_p, unravel = ravel_pytree(params)
+        flat_g, _ = ravel_pytree(grads)
+        n = flat_p.shape[0]
+        chunk = _chunk(n, world)
+        pad = world * chunk - n
+
+        flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, pad))
+        # reduce-scatter: each rank receives the summed gradient chunk it owns
+        g_chunk = jax.lax.psum_scatter(flat_g, axis, tiled=True) / world
+
+        if clip_norm is not None:
+            # global norm needs the full gradient: psum of local chunk sq-sums
+            sq = jax.lax.psum(jnp.sum(jnp.square(g_chunk)), axis)
+            norm = jnp.sqrt(sq)
+            g_chunk = g_chunk * jnp.minimum(1.0, clip_norm / (norm + 1e-6))
+
+        rank = jax.lax.axis_index(axis)
+        p_chunk = jax.lax.dynamic_slice(
+            jnp.pad(flat_p, (0, pad)), (rank * chunk,), (chunk,)
+        ).astype(jnp.float32)
+
+        m = zstate["m"][0]
+        v = zstate["v"][0]
+        t = zstate["t"] + 1
+        tf = t.astype(jnp.float32)
+        lr = hp.lr if lr_schedule is None else lr_schedule(zstate["t"])
+        b1, b2 = hp.beta1, hp.beta2
+        alpha_t = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+        m = b1 * m + (1.0 - b1) * g_chunk
+        v = b2 * v + (1.0 - b2) * jnp.square(g_chunk)
+        p_chunk = p_chunk - alpha_t * m / (jnp.sqrt(v) + hp.eps)
+        p_chunk = p_chunk - lr * hp.weight_decay * p_chunk
+
+        # all-gather the updated chunks back into the replicated flat params
+        flat_new = jax.lax.all_gather(p_chunk, axis, tiled=True)[:n]
+        params = unravel(flat_new.astype(flat_p.dtype))
+        zstate = {"m": m[None], "v": v[None], "t": t}
+        return params, zstate, loss
+
+    compiled: dict[int, Callable] = {}  # batch arity -> jitted step
+
+    def wrapper(params, zstate, *batch):
+        fn = compiled.get(len(batch))
+        if fn is None:
+            # check_vma=False: the replicated-output check cannot infer that
+            # the tiled all_gather of the updated chunks is identical on
+            # every device (jax 0.9 has no all_gather_invariant); it is
+            # replicated by construction, and the exactness tests pin it
+            # numerically.
+            fn = compiled[len(batch)] = jax.jit(
+                jax.shard_map(
+                    local_step,
+                    mesh=mesh,
+                    in_specs=(P(), {"m": P(axis), "v": P(axis), "t": P()})
+                    + (P(axis),) * len(batch),
+                    out_specs=(P(), {"m": P(axis), "v": P(axis), "t": P()}, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1) if donate else (),
+            )
+        return fn(params, zstate, *batch)
+
+    return wrapper
+
+
+def make_zero1_step_for(
+    loss_fn: Callable,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None = None,
+    lr_schedule: Callable | None = None,
+    axis: str = "dp",
+) -> Callable:
+    """Generic ZeRO-1 step for arbitrary models/losses (test seam):
+    ``(params, zstate, *batch) -> (params, zstate, loss)``."""
+    return _build_zero1_step(loss_fn, hp, mesh, clip_norm, lr_schedule, axis, False)

@@ -1,0 +1,202 @@
+"""DP equivalence tests.
+
+Mirrors the reference DDP suites (tests/test_ddp.py,
+tests/test_ddp_individual_parameters.py): per-rank differently-initialised
+models must equal rank-0 after broadcast; N steps of SGD on disjoint batch
+shards must track a single-process model trained on the full batch;
+edge cases are a frozen (requires_grad=False) parameter and tied weights;
+bucket sizes are tuned to force 1 / several / many buckets on the toy model.
+World size is the reference's 2 (subset of the 8-device CPU mesh).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cs336_systems_tpu.parallel.collectives import broadcast_from_rank0
+from cs336_systems_tpu.parallel.dp import (
+    VARIANTS,
+    assign_buckets,
+    make_dp_grad_fn,
+    sync_grads,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh, shard_batch
+
+from common import (
+    mse_loss,
+    tied_model_apply,
+    tied_model_init,
+    toy_model_apply,
+    toy_model_init,
+    trees_allclose,
+)
+
+WORLD = 2
+LR = 0.1
+STEPS = 5
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"dp": WORLD}, devices=jax.devices()[:WORLD])
+
+
+@pytest.fixture(scope="module")
+def fixture_data():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((20, 10)).astype(np.float32)
+    y = rng.standard_normal((20, 5)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def sgd(params, grads, trainable):
+    return jax.tree_util.tree_map(
+        lambda p, g, t: p - LR * g if t else p, params, grads, trainable
+    )
+
+
+def _run_single(apply_fn, params, trainable, x, y):
+    loss_fn = lambda p, xx, yy: mse_loss(apply_fn, p, xx, yy)
+    for _ in range(STEPS):
+        grads = jax.grad(loss_fn)(params, x, y)
+        params = sgd(params, grads, trainable)
+    return params
+
+
+def _run_dp(apply_fn, params, trainable, x, y, mesh, variant, bucket_mb=1000.0):
+    loss_fn = lambda p, xx, yy: mse_loss(apply_fn, p, xx, yy)
+    grad_fn = make_dp_grad_fn(
+        loss_fn, mesh, variant=variant, bucket_size_mb=bucket_mb, trainable=trainable
+    )
+    xs, ys = shard_batch(mesh, x, y)
+    for _ in range(STEPS):
+        _, grads = grad_fn(params, xs, ys)
+        params = sgd(params, grads, trainable)
+    return params
+
+
+def test_broadcast_from_rank0(mesh):
+    """Differently-seeded per-rank params must all equal rank-0 after wrap
+    (reference test_ddp.py:86-97 + validate_ddp_net_equivalence)."""
+    stacks = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[toy_model_init(jax.random.PRNGKey(100 + r))[0] for r in range(WORLD)],
+    )
+    bcast = broadcast_from_rank0(stacks, mesh)
+    rank0, _ = toy_model_init(jax.random.PRNGKey(100))
+    assert trees_allclose(bcast, rank0)
+    # and NOT equal to rank 1's independent init
+    rank1, _ = toy_model_init(jax.random.PRNGKey(101))
+    assert not trees_allclose(bcast, rank1)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_dp_matches_single_process(mesh, fixture_data, variant):
+    """DP-trained params == single-process full-batch params after 5 steps
+    (reference test_ddp.py:105-180)."""
+    x, y = fixture_data
+    params, trainable = toy_model_init(jax.random.PRNGKey(0))
+    single = _run_single(toy_model_apply, params, trainable, x, y)
+    dp = _run_dp(toy_model_apply, params, trainable, x, y, mesh, variant)
+    assert trees_allclose(single, dp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("bucket_mb", [0.0001, 0.0016, 0.01])
+def test_dp_bucketed_bucket_sizes(mesh, fixture_data, bucket_mb):
+    """Bucket sizes forcing many/2/1 buckets on the toy model all agree
+    (reference bucket-size sweep, test_ddp.py docstring 33-41)."""
+    x, y = fixture_data
+    params, trainable = toy_model_init(jax.random.PRNGKey(1))
+    single = _run_single(toy_model_apply, params, trainable, x, y)
+    dp = _run_dp(toy_model_apply, params, trainable, x, y, mesh, "bucketed", bucket_mb)
+    assert trees_allclose(single, dp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_dp_tied_weights(mesh, fixture_data, variant):
+    """One array used by two layers gets a single summed gradient and stays
+    consistent (reference ToyModelWithTiedWeights, common.py:51-68)."""
+    x, y = fixture_data
+    params, trainable = tied_model_init(jax.random.PRNGKey(2))
+    single = _run_single(tied_model_apply, params, trainable, x, y)
+    dp = _run_dp(tied_model_apply, params, trainable, x, y, mesh, variant)
+    assert trees_allclose(single, dp, rtol=1e-5, atol=1e-6)
+
+
+def test_frozen_params_untouched(mesh, fixture_data):
+    """Frozen leaves must neither be synced nor updated."""
+    x, y = fixture_data
+    params, trainable = toy_model_init(jax.random.PRNGKey(3))
+    dp = _run_dp(toy_model_apply, params, trainable, x, y, mesh, "bucketed")
+    np.testing.assert_array_equal(
+        np.asarray(dp["fc2"]["bias"]), np.asarray(params["fc2"]["bias"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dp["no_grad_fixed_param"]),
+        np.asarray(params["no_grad_fixed_param"]),
+    )
+    # trainable leaves did move
+    assert not np.allclose(np.asarray(dp["fc1"]["weight"]), np.asarray(params["fc1"]["weight"]))
+
+
+def test_assign_buckets_reverse_greedy():
+    leaves = [np.zeros(n, np.float32) for n in (100, 200, 300, 400)]
+    # 1 KB budget: reverse order walk = sizes 1600,1200,800,400 bytes
+    buckets = assign_buckets(leaves, 1600 / (1024 * 1024))
+    # reverse walk: 1600B fills a bucket; 1200B opens one (adding 800 would
+    # overflow); 800B+400B pack together
+    assert buckets == [[3], [2], [1, 0]]
+    # huge budget: single bucket, reverse order preserved
+    assert assign_buckets(leaves, 1000) == [[3, 2, 1, 0]]
+
+
+def test_sync_grads_bad_variant(mesh):
+    with pytest.raises(ValueError):
+        sync_grads({"w": jnp.ones(3)}, variant="overlapped2")
+
+
+def test_dp_lm_train_step(mesh):
+    """The full LM DP step runs on the mesh and matches single-device
+    training (both sides see the same global batch)."""
+    from cs336_systems_tpu.models.transformer import TransformerConfig
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+    from cs336_systems_tpu.train import init_train_state, make_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=32, context_length=16, d_model=32,
+        num_layers=2, num_heads=2, d_ff=64,
+    )
+    hp = AdamWHparams(lr=1e-3)
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
+    y = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 32)
+
+    single_step = make_train_step(cfg, hp, clip_norm=1.0)
+    p1, o1, l1 = single_step(params, opt, x, y)
+
+    dp_step = make_dp_train_step(cfg, hp, mesh, variant="bucketed", clip_norm=1.0, donate=False)
+    xs, ys = shard_batch(mesh, x, y)
+    p2, o2, l2 = dp_step(params, opt, xs, ys)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    assert trees_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_sync_grads_preserves_dtype(mesh):
+    """Mixed-dtype grads must come back in their own dtype for every variant
+    (no silent bf16→fp32 promotion in the flat/bucketed concat)."""
+    grads = {
+        "a": jnp.ones((4, 4), jnp.bfloat16),
+        "b": jnp.ones((4,), jnp.float32),
+    }
+    for variant in VARIANTS:
+        def local(g, variant=variant):
+            g = jax.tree_util.tree_map(lambda t: jax.lax.pcast(t, "dp", to="varying"), g)
+            return sync_grads(g, "dp", variant, 0.001)
+        fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(P(),), out_specs=P()))
+        out = fn(grads)
+        assert out["a"].dtype == jnp.bfloat16, variant
+        assert out["b"].dtype == jnp.float32, variant
