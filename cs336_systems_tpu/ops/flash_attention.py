@@ -200,7 +200,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l = l_ref[:, 0:1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0:1] + jnp.log(safe_l))[:, 0]
+        # lse block carries a 128-wide lane dim (Mosaic needs the last two
+        # block dims (8, 128)-aligned; same layout as jax's own TPU flash
+        # kernel's l/m residuals) — the host slices lane 0.
+        lse_ref[0] = jnp.broadcast_to(m_ref[:, 0:1] + jnp.log(safe_l), lse_ref.shape[1:])
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
@@ -240,11 +243,11 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bi, qi, kj: (bi, qi)),
+            pl.BlockSpec((1, bq, 128), lambda bi, qi, kj: (bi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, sq, d), in_dtype),
-            jax.ShapeDtypeStruct((b, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max m
@@ -253,7 +256,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return o[:, :n_q], lse[:, :n_q]
+    return o[:, :n_q], lse[:, :n_q, 0]
 
 
 # ---------------------------------------------------------------------------

@@ -30,10 +30,15 @@ def make_train_step(
     hp: AdamWHparams,
     clip_norm: float | None = 1.0,
     lr_schedule: Callable | None = None,
+    donate: bool = True,
 ) -> Callable:
-    """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``."""
+    """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
-    @jax.jit
+    ``donate`` hands the old params/opt-state buffers back to XLA (they are
+    consumed by the update anyway), halving the step's HBM high-water mark.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
         if clip_norm is not None:

@@ -174,7 +174,7 @@ def test_dp_lm_train_step(mesh):
     x = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
     y = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 32)
 
-    single_step = make_train_step(cfg, hp, clip_norm=1.0)
+    single_step = make_train_step(cfg, hp, clip_norm=1.0, donate=False)
     p1, o1, l1 = single_step(params, opt, x, y)
 
     dp_step = make_dp_train_step(cfg, hp, mesh, variant="bucketed", clip_norm=1.0, donate=False)
