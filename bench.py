@@ -20,16 +20,11 @@ memory profiles) is a separate package module.
 from __future__ import annotations
 
 import json
-import os
 import time
 
-# Honor an explicit CPU request before any backend initialisation: a
-# site-level PJRT plugin (tunneled TPU) can pin its platform ahead of the
-# env var, and its first init may block for minutes (see tests/conftest.py).
-if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
-    import jax
+from cs336_systems_tpu.utils.platform import honor_cpu_request
 
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_request()
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,16 @@
+"""Benchmark drivers (the reference's L4 layer, TPU-native).
+
+- ``lm``         — end-to-end LM timing: 5 named sizes, fwd / bwd /
+                   full-step / optimizer decomposition, jit vs eager,
+                   fp32 vs bf16 (reference cs336_systems/benchmark.py).
+- ``attention``  — naive vs FlashAttention microbenchmark over sequence
+                   length × head dim, with OOM-as-null rows (reference
+                   benchmark_attention.py + flashattentioncode.py).
+- ``memory``     — device-memory profiles at ctx × phase × dtype
+                   (reference benchmark.py memory snapshots).
+- Collective (all-reduce) sweeps live in
+  ``cs336_systems_tpu.parallel.collectives`` (reference
+  distributed_communication_single.py).
+
+Each module is an executable: ``python -m cs336_systems_tpu.benchmarks.lm``.
+"""

@@ -1,0 +1,182 @@
+"""Attention microbenchmark: naive (materialised S×S) vs FlashAttention.
+
+Reference parity (cs336_systems/benchmark_attention.py:25-201 and
+flashattentioncode.py:15-147): sweep sequence length {128…65536} × head dim
+{16,32,64,128}, forward and forward+backward timing, peak-memory per cell,
+OOM caught and recorded as a null row; fp32 vs bf16 grid; pandas → LaTeX.
+
+Implementations compared:
+- ``naive``     — plain softmax(QKᵀ)V with a materialised causal mask
+                  (O(S²) memory), jitted (the reference's compiled naive).
+- ``flash_ref`` — portable lax.scan online-softmax tiling.
+- ``flash``     — the Pallas TPU kernel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
+from cs336_systems_tpu.ops.flash_attention import flash_attention
+from cs336_systems_tpu.utils.profiling import peak_bytes
+from cs336_systems_tpu.utils.timing import results_table, timed
+
+SEQ_LENS = (128, 256, 1024, 4096, 16384, 65536)
+HEAD_DIMS = (16, 32, 64, 128)
+IMPLS = ("naive", "flash_ref", "flash")
+
+
+def _make_fn(impl: str, causal: bool):
+    if impl == "naive":
+
+        def fwd(q, k, v):
+            mask = causal_mask(q.shape[-2], k.shape[-2]) if causal else None
+            out, _ = attention_with_lse(q[:, None], k[:, None], v[:, None], mask)
+            return out[:, 0]
+
+    else:
+        kernel = "pallas" if impl == "flash" else "reference"
+
+        def fwd(q, k, v):
+            return flash_attention(q, k, v, causal=causal, impl=kernel)
+
+    return fwd
+
+
+def benchmark_attention_cell(
+    impl: str,
+    seq_len: int,
+    head_dim: int,
+    batch: int = 8,
+    dtype: str = "float32",
+    causal: bool = True,
+    warmup: int = 2,
+    iters: int = 10,
+    seed: int = 0,
+) -> dict:
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    dt = jnp.dtype(dtype)
+    q = jax.random.normal(kq, (batch, seq_len, head_dim), dt)
+    k = jax.random.normal(kk, (batch, seq_len, head_dim), dt)
+    v = jax.random.normal(kv, (batch, seq_len, head_dim), dt)
+
+    fwd = jax.jit(_make_fn(impl, causal))
+    loss = lambda q, k, v: jnp.sum(fwd(q, k, v).astype(jnp.float32))
+    fwd_bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    row = {
+        "impl": impl, "seq": seq_len, "d": head_dim, "batch": batch,
+        "dtype": dtype, "causal": causal,
+    }
+
+    def cell_peak(peak_before: int) -> float | None:
+        # The backend's peak counter is process-lifetime-monotonic (no
+        # reset API, unlike torch.cuda.reset_peak_memory_stats): the value
+        # is THIS phase's peak only if the counter advanced during it;
+        # otherwise an earlier, larger cell owns the number → record null.
+        after = peak_bytes()
+        return round(after / 2**20, 1) if after > peak_before else None
+
+    p0 = peak_bytes()
+    t_fwd, _ = timed(fwd, q, k, v, warmup=warmup, iters=iters)
+    row["forward_ms"] = round(t_fwd.mean_ms, 3)
+    row["fwd_peak_mb"] = cell_peak(p0)
+    p1 = peak_bytes()
+    t_fb, _ = timed(fwd_bwd, q, k, v, warmup=warmup, iters=iters)
+    row["fwd_bwd_ms"] = round(t_fb.mean_ms, 3)
+    row["backward_ms"] = round(max(t_fb.mean_ms - t_fwd.mean_ms, 0.0), 3)
+    row["fwd_bwd_peak_mb"] = cell_peak(p1)
+    return row
+
+
+def run_attention_benchmark(
+    impls=IMPLS,
+    seq_lens=SEQ_LENS,
+    head_dims=HEAD_DIMS,
+    batch: int = 8,
+    dtypes=("float32",),
+    causal: bool = True,
+    warmup: int = 2,
+    iters: int = 10,
+    latex_path: str | None = None,
+    oom_ok: bool = True,
+):
+    """Grid sweep; with ``oom_ok`` a failing cell is recorded as a null row
+    (parity with the reference's OOM-catch, benchmark_attention.py:95-109)
+    instead of aborting the sweep; ``oom_ok=False`` re-raises for debugging."""
+    rows = []
+    for impl in impls:
+        for d in head_dims:
+            for s in seq_lens:
+                for dt in dtypes:
+                    try:
+                        rows.append(
+                            benchmark_attention_cell(
+                                impl, s, d, batch=batch, dtype=dt,
+                                causal=causal, warmup=warmup, iters=iters,
+                            )
+                        )
+                    except Exception as e:
+                        if not oom_ok:
+                            raise
+                        rows.append(
+                            {"impl": impl, "seq": s, "d": d, "batch": batch,
+                             "dtype": dt, "causal": causal,
+                             "error": f"{type(e).__name__}: {str(e)[:120]}"}
+                        )
+    return results_table(rows, latex_path)
+
+
+def plot_attention_benchmark(df, out_prefix: str = "attention_bench"):
+    """Latency-vs-seq and latency-vs-d figures (parity with
+    flashattentioncode.py:155-258). Requires matplotlib + pandas."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ok = df[df.get("error").isna()] if "error" in df.columns else df
+    for metric in ("forward_ms", "fwd_bwd_ms"):
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for impl, grp in ok.groupby("impl"):
+            g = grp.groupby("seq")[metric].mean()
+            ax.plot(g.index, g.values, marker="o", label=impl)
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log")
+        ax.set_xlabel("sequence length")
+        ax.set_ylabel(f"{metric} (ms)")
+        ax.legend()
+        ax.set_title(f"Attention {metric} vs sequence length")
+        fig.tight_layout()
+        fig.savefig(f"{out_prefix}_{metric}.png", dpi=120)
+        plt.close(fig)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--impls", nargs="+", default=list(IMPLS), choices=IMPLS)
+    p.add_argument("--seqs", nargs="+", type=int, default=list(SEQ_LENS))
+    p.add_argument("--dims", nargs="+", type=int, default=list(HEAD_DIMS))
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--dtypes", nargs="+", default=["float32"])
+    p.add_argument("--no-causal", action="store_true")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--latex", default=None)
+    p.add_argument("--plots", default=None, help="prefix for output figures")
+    args = p.parse_args(argv)
+    df = run_attention_benchmark(
+        impls=args.impls, seq_lens=args.seqs, head_dims=args.dims,
+        batch=args.batch, dtypes=args.dtypes, causal=not args.no_causal,
+        iters=args.iters, latex_path=args.latex,
+    )
+    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+    if args.plots:
+        plot_attention_benchmark(df, args.plots)
+
+
+if __name__ == "__main__":
+    main()

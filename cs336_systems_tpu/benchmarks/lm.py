@@ -1,0 +1,181 @@
+"""End-to-end LM benchmark: the reference ``cs336_systems/benchmark.py``
+re-done for XLA.
+
+Reference grid (benchmark.py:27-173, 247-304): 5 named sizes (small→2.7b),
+vocab 10k, batch 4, ctx 256, 2 warmup + 10 timed iters, separate
+forward / backward / full-step / optimizer timings with device fences,
+torch.compile on/off, optional bf16 autocast, pandas → LaTeX.
+
+Here: same grid, with ``torch.compile on/off`` → ``jit vs eager`` (XLA
+whole-program compilation vs op-by-op dispatch — the honest analogue: JAX's
+"off" mode still compiles individual ops, as does torch eager via ATen
+kernels) and ``bf16 autocast`` → ``compute_dtype=bfloat16`` policy.
+Timing fences are hard device_get fences (utils/timing.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import (
+    MODEL_SIZES,
+    TransformerConfig,
+    config_for_size,
+    count_params,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
+from cs336_systems_tpu.ops.nn import cross_entropy
+from cs336_systems_tpu.train import lm_loss, make_train_step
+from cs336_systems_tpu.utils.timing import TimingResult, results_table, timed
+
+
+def benchmark_lm_size(
+    size: str,
+    context_length: int = 256,
+    batch_size: int = 4,
+    vocab_size: int = 10_000,
+    warmup: int = 2,
+    iters: int = 10,
+    compute_dtype: str = "float32",
+    attn_impl: str = "xla",
+    use_jit: bool = True,
+    seed: int = 0,
+) -> dict:
+    """One grid cell → row dict of mean±std ms for each phase."""
+    cfg = config_for_size(
+        size,
+        vocab_size=vocab_size,
+        context_length=context_length,
+        compute_dtype=compute_dtype,
+        attn_impl=attn_impl,
+    )
+    key = jax.random.PRNGKey(seed)
+    params = init_transformer_lm(key, cfg)
+    hp = AdamWHparams(lr=1e-4)
+    opt = adamw_init(params)
+
+    kx, ky = jax.random.split(jax.random.PRNGKey(seed + 1))
+    x = jax.random.randint(kx, (batch_size, context_length), 0, vocab_size)
+    y = jax.random.randint(ky, (batch_size, context_length), 0, vocab_size)
+
+    maybe_jit = jax.jit if use_jit else (lambda f, **kw: f)
+
+    fwd = maybe_jit(lambda p: lm_loss(p, x, y, cfg))
+    fwd_bwd = maybe_jit(jax.value_and_grad(lambda p: lm_loss(p, x, y, cfg)))
+    step = (
+        make_train_step(cfg, hp, clip_norm=None, donate=False)
+        if use_jit
+        else (lambda p, o, xx, yy: _eager_step(p, o, xx, yy, cfg, hp))
+    )
+    opt_only = maybe_jit(lambda p, g, o: adamw_update(p, g, o, hp))
+
+    t_fwd, _ = timed(fwd, params, warmup=warmup, iters=iters)
+    t_fb, (_, grads) = timed(fwd_bwd, params, warmup=warmup, iters=iters)
+    t_step, _ = timed(
+        step, params, opt, x, y, warmup=warmup, iters=iters,
+        carry=lambda out, args: (out[0], out[1], args[2], args[3]),
+    )
+    t_opt, _ = timed(opt_only, params, grads, opt, warmup=warmup, iters=iters)
+
+    def cell(t: TimingResult) -> str:
+        return f"{t.mean_ms:.2f}±{t.std_ms:.2f}"
+
+    return {
+        "size": size,
+        "params_M": round(count_params(params) / 1e6, 1),
+        "ctx": context_length,
+        "batch": batch_size,
+        "dtype": compute_dtype,
+        "attn": attn_impl,
+        "jit": use_jit,
+        "forward_ms": cell(t_fwd),
+        "fwd_bwd_ms": cell(t_fb),
+        "backward_ms": f"{max(t_fb.mean_ms - t_fwd.mean_ms, 0.0):.2f}",
+        "full_step_ms": cell(t_step),
+        "optimizer_ms": cell(t_opt),
+        "tokens_per_sec": round(batch_size * context_length / (t_step.mean_ms / 1e3), 1),
+    }
+
+
+def _eager_step(params, opt, x, y, cfg: TransformerConfig, hp: AdamWHparams):
+    loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
+    params, opt = adamw_update(params, grads, opt, hp)
+    return params, opt, loss
+
+
+def run_lm_benchmark(
+    sizes: Iterable[str] = ("small",),
+    context_length: int = 256,
+    batch_size: int = 4,
+    dtypes: Iterable[str] = ("float32", "bfloat16"),
+    attn_impls: Iterable[str] = ("xla",),
+    jit_modes: Iterable[bool] = (True,),
+    warmup: int = 2,
+    iters: int = 10,
+    latex_path: str | None = None,
+    oom_ok: bool = True,
+):
+    """Full grid → DataFrame. OOM cells become null rows (parity with the
+    reference's OOM-catch, benchmark_attention.py:95-109)."""
+    rows = []
+    for size in sizes:
+        for dtype in dtypes:
+            for attn in attn_impls:
+                for use_jit in jit_modes:
+                    try:
+                        rows.append(
+                            benchmark_lm_size(
+                                size,
+                                context_length=context_length,
+                                batch_size=batch_size,
+                                compute_dtype=dtype,
+                                attn_impl=attn,
+                                use_jit=use_jit,
+                                warmup=warmup,
+                                iters=iters,
+                            )
+                        )
+                    except Exception as e:  # OOM → null row
+                        if not oom_ok:
+                            raise
+                        rows.append(
+                            {"size": size, "dtype": dtype, "attn": attn,
+                             "jit": use_jit, "error": type(e).__name__}
+                        )
+    return results_table(rows, latex_path)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", nargs="+", default=["small"], choices=list(MODEL_SIZES))
+    p.add_argument("--ctx", type=int, default=256)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
+    p.add_argument("--attn", nargs="+", default=["xla"],
+                   choices=["xla", "flash", "flash_ref"])
+    p.add_argument("--eager", action="store_true", help="also run un-jitted")
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--latex", default=None)
+    args = p.parse_args(argv)
+    df = run_lm_benchmark(
+        sizes=args.sizes,
+        context_length=args.ctx,
+        batch_size=args.batch,
+        dtypes=args.dtypes,
+        attn_impls=args.attn,
+        jit_modes=(True, False) if args.eager else (True,),
+        warmup=args.warmup,
+        iters=args.iters,
+        latex_path=args.latex,
+    )
+    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+
+
+if __name__ == "__main__":
+    main()

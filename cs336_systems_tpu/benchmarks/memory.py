@@ -1,0 +1,178 @@
+"""Memory profiling benchmark: forward-only vs full-step HBM footprint.
+
+Reference parity (cs336_systems/benchmark.py:175-245, 314-353): profile the
+big model at ctx {128, 256, 512}, forward-only vs full training step,
+fp32 vs bf16, dumping an allocator snapshot per cell plus a peak-memory
+table. The reference dumps ``torch.cuda.memory`` pickles; here each cell
+writes a pprof-format ``jax.profiler.device_memory_profile`` (live HBM
+buffers by allocation site — TensorBoard memory_viewer / pprof readable)
+and the table records the backend allocator's peak-bytes counter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import (
+    MODEL_SIZES,
+    config_for_size,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.train import lm_loss, make_train_step
+from cs336_systems_tpu.utils.profiling import memory_snapshot, memory_stats, peak_bytes
+from cs336_systems_tpu.utils.timing import results_table
+
+
+def profile_memory_cell(
+    size: str,
+    context_length: int,
+    full_step: bool,
+    compute_dtype: str = "float32",
+    batch_size: int = 4,
+    vocab_size: int = 10_000,
+    snapshot_dir: str | None = None,
+    seed: int = 0,
+) -> dict:
+    cfg = config_for_size(
+        size,
+        vocab_size=vocab_size,
+        context_length=context_length,
+        compute_dtype=compute_dtype,
+    )
+    params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
+    kx, ky = jax.random.split(jax.random.PRNGKey(seed + 1))
+    x = jax.random.randint(kx, (batch_size, context_length), 0, vocab_size)
+    y = jax.random.randint(ky, (batch_size, context_length), 0, vocab_size)
+
+    phase = "fullstep" if full_step else "forward"
+    if full_step:
+        opt = adamw_init(params)
+        step = make_train_step(cfg, AdamWHparams(lr=1e-4), clip_norm=None, donate=False)
+        out = step(params, opt, x, y)
+    else:
+        out = jax.jit(lambda p: lm_loss(p, x, y, cfg))(params)
+    jax.block_until_ready(out)
+
+    if snapshot_dir:
+        tag = f"memory_ctx{context_length}_{phase}_{compute_dtype}"
+        memory_snapshot(os.path.join(snapshot_dir, f"{tag}.pb.gz"))
+
+    stats = memory_stats()
+    return {
+        "size": size,
+        "ctx": context_length,
+        "phase": phase,
+        "dtype": compute_dtype,
+        # Valid as THIS cell's peak only when the process ran just this
+        # cell (isolate=True, the default sweep mode): the backend peak
+        # counter is process-lifetime-monotonic with no reset API.
+        "peak_mb": round(peak_bytes() / 2**20, 1),
+        "in_use_mb": round(stats.get("bytes_in_use", 0) / 2**20, 1),
+        "limit_mb": round(stats.get("bytes_limit", 0) / 2**20, 1),
+    }
+
+
+def _run_cell_isolated(size, ctx, full_step, dtype, batch_size, snapshot_dir):
+    """Run one cell in a fresh interpreter so the allocator peak counter
+    starts at zero — the replacement for torch's reset_peak_memory_stats."""
+    import json
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "cs336_systems_tpu.benchmarks.memory",
+        "--cell", json.dumps({
+            "size": size, "ctx": ctx, "full_step": full_step,
+            "dtype": dtype, "batch": batch_size,
+            "snapshot_dir": snapshot_dir,
+        }),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:]
+        raise RuntimeError(f"cell subprocess failed: {' '.join(tail)}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_memory_benchmark(
+    size: str = "2.7b",
+    context_lengths=(128, 256, 512),
+    dtypes=("float32", "bfloat16"),
+    batch_size: int = 4,
+    snapshot_dir: str | None = "memory_files",
+    oom_ok: bool = True,
+    isolate: bool = True,
+):
+    """Grid sweep. ``isolate`` runs each cell in a fresh interpreter so the
+    peak counter is per-cell-accurate (slower: pays jax init per cell);
+    ``isolate=False`` shares the process and peaks are only upper bounds."""
+    rows = []
+    for ctx in context_lengths:
+        for dtype in dtypes:
+            for full_step in (False, True):
+                try:
+                    if isolate:
+                        rows.append(
+                            _run_cell_isolated(
+                                size, ctx, full_step, dtype, batch_size,
+                                snapshot_dir,
+                            )
+                        )
+                    else:
+                        rows.append(
+                            profile_memory_cell(
+                                size, ctx, full_step, compute_dtype=dtype,
+                                batch_size=batch_size, snapshot_dir=snapshot_dir,
+                            )
+                        )
+                except Exception as e:
+                    if not oom_ok:
+                        raise
+                    rows.append(
+                        {"size": size, "ctx": ctx,
+                         "phase": "fullstep" if full_step else "forward",
+                         "dtype": dtype,
+                         "error": f"{type(e).__name__}: {str(e)[:120]}"}
+                    )
+    return results_table(rows)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size", default="2.7b", choices=list(MODEL_SIZES))
+    p.add_argument("--ctx", nargs="+", type=int, default=[128, 256, 512])
+    p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--snapshot-dir", default="memory_files")
+    p.add_argument("--no-isolate", action="store_true",
+                   help="share one process (peaks become upper bounds)")
+    p.add_argument("--cell", default=None, help=argparse.SUPPRESS)  # internal
+    args = p.parse_args(argv)
+
+    if args.cell is not None:  # isolated single-cell mode
+        import json
+
+        spec = json.loads(args.cell)
+        row = profile_memory_cell(
+            spec["size"], spec["ctx"], spec["full_step"],
+            compute_dtype=spec["dtype"], batch_size=spec["batch"],
+            snapshot_dir=spec["snapshot_dir"],
+        )
+        print(json.dumps(row))
+        return
+
+    df = run_memory_benchmark(
+        size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
+        batch_size=args.batch, snapshot_dir=args.snapshot_dir,
+        isolate=not args.no_isolate,
+    )
+    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+
+
+if __name__ == "__main__":
+    main()

@@ -190,20 +190,29 @@ def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig):
     b, s, _ = x.shape
     h, dh = cfg.num_heads, cfg.d_head
     split = lambda t: t.reshape(b, s, h, dh).transpose(0, 2, 1, 3)  # [B,H,S,Dh]
-    q = split(linear(p["q_proj"], x, cfg.cdtype))
-    k = split(linear(p["k_proj"], x, cfg.cdtype))
-    v = split(linear(p["v_proj"], x, cfg.cdtype))
-    q = apply_rope(q, cos, sin, positions)
-    k = apply_rope(k, cos, sin, positions)
-    out = _attention(q, k, v, cfg)
+    with jax.named_scope("qkv_proj"):
+        q = split(linear(p["q_proj"], x, cfg.cdtype))
+        k = split(linear(p["k_proj"], x, cfg.cdtype))
+        v = split(linear(p["v_proj"], x, cfg.cdtype))
+    with jax.named_scope("rope"):
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+    with jax.named_scope("sdpa"):
+        out = _attention(q, k, v, cfg)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
-    return linear(p["output_proj"], out, cfg.cdtype)
+    with jax.named_scope("out_proj"):
+        return linear(p["output_proj"], out, cfg.cdtype)
 
 
 def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig):
-    """Pre-norm block: x + attn(ln1 x); then x + ffn(ln2 x)."""
-    x = x + _mha(block_params["attn"], rmsnorm(block_params["ln1"], x), cos, sin, positions, cfg)
-    x = x + swiglu(block_params["ffn"], rmsnorm(block_params["ln2"], x), cfg.cdtype)
+    """Pre-norm block: x + attn(ln1 x); then x + ffn(ln2 x).
+
+    ``named_scope`` tags every stage in HLO metadata and profiler traces —
+    the NVTX-range parity (reference transformer_annotated.py:35-98)."""
+    with jax.named_scope("attn"):
+        x = x + _mha(block_params["attn"], rmsnorm(block_params["ln1"], x), cos, sin, positions, cfg)
+    with jax.named_scope("ffn"):
+        x = x + swiglu(block_params["ffn"], rmsnorm(block_params["ln2"], x), cfg.cdtype)
     return x
 
 
@@ -226,17 +235,21 @@ def transformer_lm(
         positions = jnp.arange(s)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
 
-    x = embedding(params["token_embeddings"], token_ids, cfg.cdtype)
+    with jax.named_scope("embed"):
+        x = embedding(params["token_embeddings"], token_ids, cfg.cdtype)
 
     def body(carry, bp):
         return _block(bp, carry, cos, sin, positions, cfg), None
 
     if cfg.remat:
         body = jax.checkpoint(body, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    with jax.named_scope("blocks"):
+        x, _ = jax.lax.scan(body, x, params["blocks"])
 
-    x = rmsnorm(params["ln_final"], x)
-    return linear(params["lm_head"], x, cfg.cdtype)
+    with jax.named_scope("final_norm"):
+        x = rmsnorm(params["ln_final"], x)
+    with jax.named_scope("lm_head"):
+        return linear(params["lm_head"], x, cfg.cdtype)
 
 
 # ---------------------------------------------------------------------------

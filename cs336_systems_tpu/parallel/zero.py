@@ -66,10 +66,12 @@ def zero1_init(params, mesh: Mesh, axis: str = "dp"):
     world = mesh.shape[axis]
     chunk = _chunk(_flat_size(params), world)
     sh = NamedSharding(mesh, P(axis))
-    zeros = jnp.zeros((world, chunk), jnp.float32)
+    # m and v must be DISTINCT buffers: device_put can alias an identical
+    # committed array, and a donated step would then donate one buffer twice.
+    make = lambda: jax.device_put(jnp.zeros((world, chunk), jnp.float32), sh)
     return {
-        "m": jax.device_put(zeros, sh),
-        "v": jax.device_put(zeros, sh),
+        "m": make(),
+        "v": make(),
         "t": jnp.zeros((), jnp.int32),
     }
 

@@ -1,0 +1,34 @@
+"""Backend/platform selection helpers.
+
+One shared implementation of the CPU-pin workaround used by every entry
+point (bench.py, __graft_entry__.py, tests/conftest.py): a site-level PJRT
+plugin (e.g. a tunneled TPU) can pin its own platform ahead of the
+``JAX_PLATFORMS`` env var, and its first initialisation can block for
+minutes — so an explicit CPU request must be honored via a live-config
+update *before* any backend initialisation.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_request() -> None:
+    """If ``JAX_PLATFORMS`` asks for cpu, pin the CPU backend.
+
+    Safe to call at any time: pre-init it prevents the plugin backend from
+    ever initialising; post-init it drops already-created backends (the
+    ``xla_force_host_platform_device_count`` XLA flag is parsed at process
+    start, so a virtual-device request in the env still takes effect).
+    """
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()  # no-op if nothing initialised yet
+    except Exception:
+        pass

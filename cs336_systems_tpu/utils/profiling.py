@@ -1,0 +1,93 @@
+"""Tracing and memory profiling — the TPU replacement for the reference's
+NVTX + Nsight + CUDA-allocator workflow (SURVEY §5).
+
+Reference surface → here:
+
+- ``torch.cuda.nvtx.range_push/pop`` around model stages
+  (transformer_annotated.py:35-98) → ``annotate`` (``jax.named_scope`` /
+  ``jax.profiler.TraceAnnotation``): names show up in XLA HLO op metadata
+  and in profiler traces.
+- ``nsys profile -o result ...`` (benchmark.py:310-311) → ``trace``:
+  a context manager writing a TensorBoard/Perfetto trace directory; view
+  with ``tensorboard --logdir`` or ui.perfetto.dev.
+- ``torch.cuda.memory._record_memory_history`` + ``_dump_snapshot``
+  pickles (benchmark.py:86, 213, 241) → ``memory_snapshot``: a
+  ``jax.profiler.device_memory_profile`` pprof proto, plus
+  ``live_buffer_stats`` for a human-readable summary.
+- ``torch.cuda.max_memory_allocated`` → ``peak_bytes`` (TPU allocator
+  stats; 0 on backends that do not expose them).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+
+def annotate(name: str):
+    """Scope both the trace (host-side annotation) and the HLO metadata
+    (device-side op names) — dual parity with an NVTX range."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed block into ``logdir``.
+
+    The TPU analogue of wrapping a run in ``nsys profile``; produces
+    TensorBoard `plugins/profile` data (includes XLA op breakdown, HBM
+    usage, and any ``annotate`` scopes).
+    """
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def memory_snapshot(path: str) -> None:
+    """Write a pprof-format device memory profile (live HBM buffers by
+    allocation site). Parity with the reference's allocator-history pickles;
+    view with ``pprof`` or TensorBoard's memory_viewer."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(jax.profiler.device_memory_profile())
+
+
+def live_buffer_bytes(device=None) -> int:
+    """Total bytes of live arrays on ``device`` (default: all devices)."""
+    total = 0
+    for arr in jax.live_arrays():
+        for shard in getattr(arr, "addressable_shards", []):
+            if device is None or shard.device == device:
+                total += shard.data.nbytes if hasattr(shard.data, "nbytes") else 0
+    return total
+
+
+def peak_bytes(device=None) -> int:
+    """Peak device-memory-in-use if the backend exposes allocator stats
+    (TPU does: ``device.memory_stats()['peak_bytes_in_use']``); 0 otherwise.
+    Parity with ``torch.cuda.max_memory_allocated``."""
+    devices = [device] if device is not None else jax.local_devices()
+    peak = 0
+    for d in devices:
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            peak = max(peak, stats.get("peak_bytes_in_use", 0))
+    return peak
+
+
+def memory_stats(device=None) -> dict:
+    """Raw allocator stats dict from the backend ({} if unavailable)."""
+    devices = [device] if device is not None else jax.local_devices()
+    for d in devices:
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            return dict(stats)
+    return {}

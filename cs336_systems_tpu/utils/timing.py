@@ -1,0 +1,141 @@
+"""Wall-clock timing utilities for device benchmarks.
+
+The reference fences every measurement with ``torch.cuda.synchronize``
+(cs336_systems/benchmark.py:91-117, naive_ddp.py:344-377); the XLA analogue
+is ``jax.block_until_ready`` on the computation's outputs — XLA dispatch is
+async, so timing without a fence measures enqueue latency, not execution.
+On some experimental PJRT transports ``block_until_ready`` has been observed
+to return early, so ``timed`` hard-fences by fetching one scalar/element to
+the host (``device_get``), which cannot complete before the computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TimingResult:
+    """Per-iteration wall-clock stats, in milliseconds."""
+
+    mean_ms: float
+    std_ms: float
+    min_ms: float
+    max_ms: float
+    iters: int
+    times_ms: tuple
+
+    def __str__(self) -> str:
+        return f"{self.mean_ms:.3f} ± {self.std_ms:.3f} ms (n={self.iters})"
+
+
+def _fence(out: Any) -> None:
+    """Hard host↔device fence over the WHOLE output tree.
+
+    ``block_until_ready`` waits on every leaf (eager/multi-dispatch outputs
+    are many independent computations — fencing one leaf would let siblings
+    leak past the timer); the trailing one-element ``device_get`` guards
+    against transports whose ready-signal has been observed to return early.
+    """
+    jax.block_until_ready(out)
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "devices"):
+            np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
+            return
+    # no device arrays in the output: nothing to fence
+
+
+def timed(
+    fn: Callable,
+    *args,
+    warmup: int = 2,
+    iters: int = 10,
+    carry: Callable | None = None,
+) -> tuple[TimingResult, Any]:
+    """Time ``fn(*args)`` per-iteration with device fencing.
+
+    ``carry``: optional ``(out, args) -> args`` threading outputs back into
+    the next call (for training steps whose params/opt-state evolve, and for
+    donated buffers which must not be reused).
+
+    Returns ``(TimingResult, last_output)``.
+    """
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+        if carry is not None:
+            args = carry(out, args)
+    _fence(out) if out is not None else None
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _fence(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+        if carry is not None:
+            args = carry(out, args)
+    return (
+        TimingResult(
+            mean_ms=statistics.fmean(times),
+            std_ms=statistics.stdev(times) if len(times) > 1 else 0.0,
+            min_ms=min(times),
+            max_ms=max(times),
+            iters=iters,
+            times_ms=tuple(times),
+        ),
+        out,
+    )
+
+
+def timed_total(fn: Callable, *args, warmup: int = 2, iters: int = 10, **kw):
+    """Like ``timed`` but one fence around the whole timed loop (amortised
+    per-step time — the right measure for pipelined training throughput)."""
+    carry = kw.pop("carry", None)
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+        if carry is not None:
+            args = carry(out, args)
+    _fence(out) if out is not None else None
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        if carry is not None:
+            args = carry(out, args)
+    _fence(out)
+    total_ms = (time.perf_counter() - t0) * 1e3
+    per = total_ms / iters
+    return (
+        TimingResult(
+            mean_ms=per, std_ms=0.0, min_ms=per, max_ms=per, iters=iters,
+            times_ms=(per,) * iters,
+        ),
+        out,
+    )
+
+
+def results_table(rows: Sequence[dict], latex_path: str | None = None):
+    """Rows of {col: value} → pandas DataFrame (printed + optional LaTeX),
+    mirroring the reference's pandas/LaTeX reporting (benchmark.py:168-170).
+    Falls back to a plain text table when pandas is unavailable."""
+    try:
+        import pandas as pd
+
+        df = pd.DataFrame(list(rows))
+        if latex_path:
+            with open(latex_path, "w") as f:
+                f.write(df.to_latex(index=False, float_format="%.3f"))
+        return df
+    except ImportError:  # pragma: no cover
+        cols = list(rows[0].keys()) if rows else []
+        lines = ["\t".join(cols)]
+        lines += ["\t".join(str(r.get(c, "")) for c in cols) for r in rows]
+        return "\n".join(lines)
