@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
 from cs336_systems_tpu.ops.flash_attention import flash_attention
 from cs336_systems_tpu.utils.profiling import peak_bytes
-from cs336_systems_tpu.utils.timing import results_table, timed
+from cs336_systems_tpu.utils.timing import error_cell, print_table, results_table, timed
 
 SEQ_LENS = (128, 256, 1024, 4096, 16384, 65536)
 HEAD_DIMS = (16, 32, 64, 128)
@@ -126,7 +126,7 @@ def run_attention_benchmark(
                         rows.append(
                             {"impl": impl, "seq": s, "d": d, "batch": batch,
                              "dtype": dt, "causal": causal,
-                             "error": f"{type(e).__name__}: {str(e)[:120]}"}
+                             "error": error_cell(e)}
                         )
     return results_table(rows, latex_path)
 
@@ -164,6 +164,7 @@ def main(argv=None) -> None:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--dtypes", nargs="+", default=["float32"])
     p.add_argument("--no-causal", action="store_true")
+    p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--latex", default=None)
     p.add_argument("--plots", default=None, help="prefix for output figures")
@@ -171,9 +172,9 @@ def main(argv=None) -> None:
     df = run_attention_benchmark(
         impls=args.impls, seq_lens=args.seqs, head_dims=args.dims,
         batch=args.batch, dtypes=args.dtypes, causal=not args.no_causal,
-        iters=args.iters, latex_path=args.latex,
+        warmup=args.warmup, iters=args.iters, latex_path=args.latex,
     )
-    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+    print_table(df)
     if args.plots:
         plot_attention_benchmark(df, args.plots)
 

@@ -31,7 +31,13 @@ from cs336_systems_tpu.models.transformer import (
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
 from cs336_systems_tpu.ops.nn import cross_entropy
 from cs336_systems_tpu.train import lm_loss, make_train_step
-from cs336_systems_tpu.utils.timing import TimingResult, results_table, timed
+from cs336_systems_tpu.utils.timing import (
+    TimingResult,
+    error_cell,
+    print_table,
+    results_table,
+    timed,
+)
 
 
 def benchmark_lm_size(
@@ -145,7 +151,7 @@ def run_lm_benchmark(
                             raise
                         rows.append(
                             {"size": size, "dtype": dtype, "attn": attn,
-                             "jit": use_jit, "error": type(e).__name__}
+                             "jit": use_jit, "error": error_cell(e)}
                         )
     return results_table(rows, latex_path)
 
@@ -174,7 +180,7 @@ def main(argv=None) -> None:
         iters=args.iters,
         latex_path=args.latex,
     )
-    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+    print_table(df)
 
 
 if __name__ == "__main__":

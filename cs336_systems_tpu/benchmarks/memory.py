@@ -25,7 +25,7 @@ from cs336_systems_tpu.models.transformer import (
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
 from cs336_systems_tpu.train import lm_loss, make_train_step
 from cs336_systems_tpu.utils.profiling import memory_snapshot, memory_stats, peak_bytes
-from cs336_systems_tpu.utils.timing import results_table
+from cs336_systems_tpu.utils.timing import error_cell, print_table, results_table
 
 
 def profile_memory_cell(
@@ -137,7 +137,7 @@ def run_memory_benchmark(
                         {"size": size, "ctx": ctx,
                          "phase": "fullstep" if full_step else "forward",
                          "dtype": dtype,
-                         "error": f"{type(e).__name__}: {str(e)[:120]}"}
+                         "error": error_cell(e)}
                     )
     return results_table(rows)
 
@@ -171,7 +171,7 @@ def main(argv=None) -> None:
         batch_size=args.batch, snapshot_dir=args.snapshot_dir,
         isolate=not args.no_isolate,
     )
-    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
+    print_table(df)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,161 @@
+"""Mixed-precision policy + the reference's precision demonstrations.
+
+Reference surface → here:
+
+- ``torch.autocast(bf16/fp16)`` implicit casting → an explicit
+  ``Policy`` (params / compute / output dtypes): JAX has no autocast; the
+  policy is applied by construction (the model casts weights+activations
+  to ``compute_dtype`` per op, keeps RMSNorm/softmax/CE internals fp32 —
+  exactly the dtype placement torch autocast *discovers* and the
+  reference introspects in mixed_precision_testing.py:33-51).
+- ``cs336_systems/precision.py:1-23`` (summing 1000 × 0.01 four ways to
+  show fp16 accumulation error) → ``accumulate``: the same four variants
+  as pure functions, unit-tested in tests/test_precision.py.
+- ``mixed_precision_testing.py`` ToyModel dtype introspection →
+  ``introspect_dtypes``: runs a toy fc→relu→norm→fc model under a policy
+  and reports the dtype at every stage (params, matmul output, norm
+  output, logits, loss, grads).
+
+TPU notes: bf16 is the MXU-native input dtype; accumulation inside the MXU
+is fp32 (``preferred_element_type``), so the *torch-fp16 + GradScaler*
+pattern has no TPU equivalent — bf16's fp32-sized exponent makes loss
+scaling unnecessary, which is why only bf16/fp32 policies are offered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype placement for a model: where numbers live and where math runs.
+
+    ``norm_dtype`` is fp32 in every offered policy — RMSNorm/softmax/CE
+    internals upcasting is load-bearing for bf16 training stability.
+    """
+
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str | None = None  # None: same as compute
+    norm_dtype: str = "float32"
+
+    @property
+    def pdtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def cdtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def odtype(self):
+        return jnp.dtype(self.output_dtype or self.compute_dtype)
+
+    def cast_params(self, params):
+        return jax.tree_util.tree_map(lambda p: p.astype(self.pdtype), params)
+
+    def cast_compute(self, *xs):
+        out = tuple(x.astype(self.cdtype) for x in xs)
+        return out[0] if len(out) == 1 else out
+
+
+FP32 = Policy()
+MIXED_BF16 = Policy(param_dtype="float32", compute_dtype="bfloat16")
+PURE_BF16 = Policy(param_dtype="bfloat16", compute_dtype="bfloat16")
+
+POLICIES = {"fp32": FP32, "mixed_bf16": MIXED_BF16, "pure_bf16": PURE_BF16}
+
+
+def accumulate(
+    n: int = 1000,
+    value: float = 0.01,
+    acc_dtype=jnp.float32,
+    add_dtype=None,
+) -> jax.Array:
+    """Sum ``n`` copies of ``value``: accumulator in ``acc_dtype``, each
+    addend (optionally) cast to ``add_dtype`` first.
+
+    The reference's four variants (precision.py:1-23) map to:
+    fp32+fp32  → (float32, None)        == 10.0 exactly enough
+    fp16 acc   → (float16, None)        drifts (0.01 not representable,
+                                        and past 2048 fp16 loses +0.01)
+    fp32 acc of fp16 addends → (float32, float16)  small constant bias
+    """
+    addend = jnp.asarray(value, add_dtype or acc_dtype).astype(acc_dtype)
+
+    def body(carry, _):
+        return carry + addend, None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), acc_dtype), None, length=n)
+    return total
+
+
+def accumulation_error(n: int = 1000, value: float = 0.01) -> dict[str, float]:
+    """The demo as data: |sum - n*value| per variant."""
+    exact = n * value
+    variants = {
+        "fp32": accumulate(n, value, jnp.float32),
+        "fp16_acc": accumulate(n, value, jnp.float16),
+        "bf16_acc": accumulate(n, value, jnp.bfloat16),
+        "fp32_acc_fp16_add": accumulate(n, value, jnp.float32, jnp.float16),
+        "fp32_acc_bf16_add": accumulate(n, value, jnp.float32, jnp.bfloat16),
+    }
+    return {k: abs(float(v) - exact) for k, v in variants.items()}
+
+
+# ---------------------------------------------------------------------------
+# Autocast-introspection parity (mixed_precision_testing.py ToyModel)
+
+
+def _toy_init(key, d_in=16, d_hidden=32, d_out=4, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return {
+        "fc1": {"weight": jax.random.normal(k1, (d_hidden, d_in), dtype) * 0.1},
+        "ln": {"weight": jnp.ones((d_hidden,), dtype)},
+        "fc2": {"weight": jax.random.normal(k2, (d_out, d_hidden), dtype) * 0.1},
+    }
+
+
+def _toy_apply(params, x, policy: Policy, taps: dict | None = None):
+    """fc1 → relu → RMSNorm (fp32 internals) → fc2, recording stage dtypes."""
+    from cs336_systems_tpu.models.layers import linear, rmsnorm
+
+    h = linear(params["fc1"], x, policy.cdtype)
+    if taps is not None:
+        taps["fc1_output"] = h.dtype
+    h = jax.nn.relu(h)
+    h = rmsnorm(params["ln"], h)  # upcasts to fp32 internally, returns input dtype
+    if taps is not None:
+        taps["norm_output"] = h.dtype
+    logits = linear(params["fc2"], h, policy.cdtype)
+    if taps is not None:
+        taps["logits"] = logits.dtype
+    return logits
+
+
+def introspect_dtypes(policy: Policy, batch: int = 8) -> dict[str, jnp.dtype]:
+    """Report the dtype at every stage of a toy model under ``policy`` —
+    the JAX answer to the reference's autocast printouts
+    (mixed_precision_testing.py:33-51: params fp32, matmul outputs bf16,
+    norm output fp32(torch)/compute(here, upcast inside), grads fp32)."""
+    params = _toy_init(jax.random.PRNGKey(0), dtype=policy.pdtype)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 16), jnp.float32)
+
+    taps: dict = {}
+    logits = _toy_apply(params, x, policy, taps)
+
+    def loss_fn(p):
+        lg = _toy_apply(p, x, policy).astype(jnp.float32)  # CE-style fp32 loss
+        return jnp.mean(jnp.square(lg))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    taps.update(
+        params=params["fc1"]["weight"].dtype,
+        loss=loss.dtype,
+        grads=grads["fc1"]["weight"].dtype,
+    )
+    return {k: jnp.dtype(v) for k, v in taps.items()}

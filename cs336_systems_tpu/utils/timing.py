@@ -46,9 +46,9 @@ def _fence(out: Any) -> None:
     jax.block_until_ready(out)
     for leaf in jax.tree_util.tree_leaves(out):
         if hasattr(leaf, "addressable_shards") or hasattr(leaf, "devices"):
+            # one element per leaf: leaves are independent computations
+            # (eager/multi-dispatch), so each needs its own hard fence
             np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
-            return
-    # no device arrays in the output: nothing to fence
 
 
 def timed(
@@ -120,6 +120,17 @@ def timed_total(fn: Callable, *args, warmup: int = 2, iters: int = 10, **kw):
         ),
         out,
     )
+
+
+def error_cell(e: Exception) -> str:
+    """Uniform error-row format for benchmark sweeps (keep the message:
+    an OOM and a shape bug must be distinguishable from the table)."""
+    return f"{type(e).__name__}: {str(e)[:120]}"
+
+
+def print_table(df) -> None:
+    """Print a results_table return value (DataFrame or plain string)."""
+    print(df.to_string(index=False) if hasattr(df, "to_string") else df)
 
 
 def results_table(rows: Sequence[dict], latex_path: str | None = None):

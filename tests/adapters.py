@@ -1,0 +1,104 @@
+"""Adapter seam: the public API contract, mirrored from the reference.
+
+The reference's only "public API" is tests/adapters.py — staff tests call
+student code exclusively through these ``get_*`` functions
+(/root/reference/tests/adapters.py:10-140). This file keeps the same seam
+shape with TPU-native return values, so the reference test *intent* maps
+one-to-one:
+
+| reference adapter                                | returns (torch)        | here returns (jax)                    |
+|--------------------------------------------------|------------------------|---------------------------------------|
+| get_flashattention_autograd_function_pytorch     | autograd.Function      | differentiable fn (portable tiling)   |
+| get_flashattention_autograd_function_triton      | autograd.Function      | differentiable fn (Pallas TPU kernel) |
+| get_ddp_individual_parameters (+ on_after_backward) | DDP wrapper module  | per-leaf-collective DP grad fn        |
+| get_ddp_bucketed (+ hooks)                       | DDP_Bucketed module    | bucketed DP grad fn                   |
+| get_sharded_optimizer                            | ZeRO-1 optimizer       | ZeRO-1 sharded AdamW step             |
+
+An ``torch.autograd.Function`` and a ``jax.custom_vjp``-wrapped function are
+the same contract (forward + custom backward); DDP wrapper classes map to
+gradient-synchronising step functions because JAX models are pytrees, not
+modules — the hook-driven ``on_after_backward`` lifecycle collapses into
+the jitted step itself (XLA schedules the overlap; SURVEY §3.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+from cs336_systems_tpu.ops import flash_attention as _fa
+
+
+def get_flashattention_autograd_function_pytorch() -> Callable:
+    """Portable tiled online-softmax attention (reference
+    FlashAttentionTorch, flash_attention.py:8-83): differentiable
+    ``fn(q, k, v, causal=False) -> O`` with the recompute backward."""
+    return functools.partial(_fa.flash_attention, impl="reference")
+
+
+def get_flashattention_autograd_function_triton() -> Callable:
+    """Native-kernel attention (reference FlashAttentionTriton,
+    flash_attention.py:85-266): the Pallas (Mosaic) TPU kernel, interpreter
+    mode off-TPU. Saves exactly (Q, K, V, O, L) with L the logsumexp —
+    the residual contract the reference forward test asserts."""
+    return functools.partial(_fa.flash_attention, impl="pallas")
+
+
+def get_flashattention_with_lse(impl: str = "pallas") -> Callable:
+    """(O, L) variant used by the forward-LSE contract test."""
+    return functools.partial(_fa.flash_attention_with_lse, impl=impl)
+
+
+def get_ddp_individual_parameters(loss_fn, mesh, trainable=None) -> Callable:
+    """Per-parameter-collective DP (reference DDP wrapper,
+    ddp_bucketed_overlapped_sharded.py:217-248): returns
+    ``(params, *batch) -> (loss, synced_grads)`` with one independent
+    all-reduce per gradient leaf — XLA's scheduler overlaps them with the
+    remaining backward, which is what the reference's per-param async
+    hooks + reverse-order waits implement by hand."""
+    from cs336_systems_tpu.parallel.dp import make_dp_grad_fn
+
+    return make_dp_grad_fn(loss_fn, mesh, variant="naive", trainable=trainable)
+
+
+def ddp_individual_parameters_on_after_backward(ddp_model, optimizer) -> None:
+    """No-op by design: gradient synchronisation is *inside* the jitted
+    step (there is no separate post-backward phase to hook). Kept so the
+    reference test-lifecycle shape still maps."""
+
+
+def get_ddp_bucketed(loss_fn, mesh, bucket_size_mb: float, trainable=None) -> Callable:
+    """Bucketed DP (reference DDP_Bucketed,
+    ddp_bucketed_overlapped_sharded.py:251-318): reverse-order ≤size_mb
+    buckets, one concatenated all-reduce per bucket."""
+    from cs336_systems_tpu.parallel.dp import make_dp_grad_fn
+
+    return make_dp_grad_fn(
+        loss_fn, mesh, variant="bucketed",
+        bucket_size_mb=bucket_size_mb, trainable=trainable,
+    )
+
+
+def ddp_bucketed_on_after_backward(ddp_model, optimizer) -> None:
+    """No-op by design (see ddp_individual_parameters_on_after_backward)."""
+
+
+def ddp_bucketed_on_train_batch_start(ddp_model, optimizer) -> None:
+    """No-op by design: bucket counters/handles do not exist — the jitted
+    step has no cross-step communication state to reset."""
+
+
+def get_sharded_optimizer(params, mesh, hp=None, loss_fn=None, **kwargs):
+    """ZeRO-1 sharded AdamW (reference ShardedStateOptimizer,
+    ddp_bucketed_overlapped_sharded.py:322-362): returns
+    ``(zstate, step_fn)`` where the state is index-sharded over the mesh's
+    dp axis and ``step_fn(params, zstate, *batch)`` does
+    reduce-scatter → owner-computes AdamW → all-gather."""
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+    from cs336_systems_tpu.parallel.zero import make_zero1_step_for, zero1_init
+
+    hp = hp or AdamWHparams(**kwargs)
+    zstate = zero1_init(params, mesh)
+    if loss_fn is None:
+        raise ValueError("loss_fn required: the ZeRO-1 step fuses grad+update")
+    return zstate, make_zero1_step_for(loss_fn, hp, mesh)

@@ -1,0 +1,128 @@
+"""Mixed-precision tests.
+
+The reference's precision scripts are demos (precision.py,
+mixed_precision_testing.py — print-only); here their observations are
+pinned as assertions, per SURVEY §7.7 ("the fp16 accumulation demo becomes
+a dtype-accumulation unit test").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs336_systems_tpu.ops.precision import (
+    FP32,
+    MIXED_BF16,
+    PURE_BF16,
+    Policy,
+    accumulate,
+    accumulation_error,
+    introspect_dtypes,
+)
+
+
+class TestAccumulation:
+    """Reference precision.py:1-23 — 1000 × 0.01 four ways."""
+
+    def test_fp32_accumulation_accurate(self):
+        err = abs(float(accumulate(1000, 0.01, jnp.float32)) - 10.0)
+        assert err < 1e-3
+
+    def test_fp16_accumulation_drifts(self):
+        # fp16 cannot represent 0.01 exactly and loses increments as the
+        # accumulator grows; the error is orders of magnitude above fp32's.
+        err = abs(float(accumulate(1000, 0.01, jnp.float16)) - 10.0)
+        assert err > 0.01
+
+    def test_bf16_accumulation_much_worse(self):
+        # bf16 has 8 mantissa bits: accumulation error is large — this is
+        # exactly why moments/accumulators stay fp32 in mixed policies.
+        err = abs(float(accumulate(1000, 0.01, jnp.bfloat16)) - 10.0)
+        assert err > 0.1
+
+    def test_fp32_acc_of_low_precision_addends_small_bias(self):
+        # fp32 accumulator fixes the drift even with low-precision addends:
+        # only the constant representation error of 0.01 remains.
+        err16 = abs(float(accumulate(1000, 0.01, jnp.float32, jnp.float16)) - 10.0)
+        err_pure16 = abs(float(accumulate(1000, 0.01, jnp.float16)) - 10.0)
+        assert err16 < err_pure16
+
+    def test_error_table_ordering(self):
+        errs = accumulation_error()
+        assert errs["fp32"] < errs["fp16_acc"] < errs["bf16_acc"]
+        assert errs["fp32_acc_fp16_add"] < errs["fp16_acc"]
+
+
+class TestPolicyIntrospection:
+    """Reference mixed_precision_testing.py:33-51 — where dtypes land."""
+
+    def test_mixed_bf16_placement(self):
+        d = introspect_dtypes(MIXED_BF16)
+        assert d["params"] == jnp.float32  # master weights fp32
+        assert d["fc1_output"] == jnp.bfloat16  # matmul runs in bf16
+        assert d["norm_output"] == jnp.bfloat16  # fp32 inside, recast out
+        assert d["logits"] == jnp.bfloat16
+        assert d["loss"] == jnp.float32  # loss upcast
+        assert d["grads"] == jnp.float32  # grads w.r.t. fp32 params
+
+    def test_fp32_placement(self):
+        d = introspect_dtypes(FP32)
+        assert all(jnp.dtype(v) == jnp.float32 for v in d.values())
+
+    def test_pure_bf16_placement(self):
+        d = introspect_dtypes(PURE_BF16)
+        assert d["params"] == jnp.bfloat16
+        assert d["grads"] == jnp.bfloat16
+
+    def test_policy_casting_helpers(self):
+        p = Policy(param_dtype="bfloat16", compute_dtype="bfloat16")
+        tree = {"w": jnp.ones((2, 2), jnp.float32)}
+        assert p.cast_params(tree)["w"].dtype == jnp.bfloat16
+        a, b = p.cast_compute(jnp.ones(2), jnp.zeros(2))
+        assert a.dtype == b.dtype == jnp.bfloat16
+
+
+class TestModelUnderPolicy:
+    """The policy contract holds through the real Transformer LM."""
+
+    @pytest.mark.parametrize("compute_dtype", ["float32", "bfloat16"])
+    def test_lm_forward_dtype_and_finite(self, compute_dtype):
+        from cs336_systems_tpu.models.transformer import (
+            TransformerConfig,
+            init_transformer_lm,
+            transformer_lm,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=64, context_length=16, d_model=32,
+            num_layers=2, num_heads=2, d_ff=64,
+            compute_dtype=compute_dtype,
+        )
+        params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+        # params stay fp32 regardless of compute dtype
+        assert params["lm_head"]["weight"].dtype == jnp.float32
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        logits = transformer_lm(params, ids, cfg)
+        assert logits.dtype == jnp.dtype(compute_dtype)
+        assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))
+
+    def test_bf16_loss_close_to_fp32(self):
+        """bf16 compute must track the fp32 loss closely at init — the
+        autocast-equivalence sanity the reference eyeballs by printing."""
+        from cs336_systems_tpu.models.transformer import (
+            TransformerConfig,
+            init_transformer_lm,
+        )
+        from cs336_systems_tpu.train import lm_loss
+
+        mk = lambda cd: TransformerConfig(
+            vocab_size=64, context_length=16, d_model=32,
+            num_layers=2, num_heads=2, d_ff=64, compute_dtype=cd,
+        )
+        params = init_transformer_lm(jax.random.PRNGKey(0), mk("float32"))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        tgt = jnp.roll(ids, -1, axis=-1)
+        l32 = float(lm_loss(params, ids, tgt, mk("float32")))
+        l16 = float(lm_loss(params, ids, tgt, mk("bfloat16")))
+        assert abs(l32 - l16) / abs(l32) < 0.05
