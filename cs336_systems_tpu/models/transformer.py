@@ -56,16 +56,19 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
-    attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref"
+    attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref" | "ring"
     remat: bool = False  # rematerialise each block in backward
+    sp_axis: str | None = None  # mesh axis of the sequence shard ("ring" only)
 
     def __post_init__(self):
         if self.d_model % self.num_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
             )
-        if self.attn_impl not in ("xla", "flash", "flash_ref"):
+        if self.attn_impl not in ("xla", "flash", "flash_ref", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
+        if self.attn_impl == "ring" and not self.sp_axis:
+            raise ValueError("attn_impl='ring' requires sp_axis")
 
     @property
     def d_head(self) -> int:
@@ -178,6 +181,16 @@ def _attention(q, k, v, cfg: TransformerConfig):
             fold(q), fold(k), fold(v), causal=True,
             impl="pallas" if cfg.attn_impl == "flash" else "reference",
         )
+        return out.reshape(b, h, s, dh)
+    elif cfg.attn_impl == "ring":
+        # sequence-parallel exact attention: must be called inside a
+        # shard_map whose mesh has cfg.sp_axis; q/k/v here hold the LOCAL
+        # sequence shard, and positions carry the global offsets.
+        from cs336_systems_tpu.parallel.ring import ring_attention
+
+        b, h, s, dh = q.shape
+        fold = lambda x: x.reshape(b * h, s, dh)
+        out = ring_attention(fold(q), fold(k), fold(v), axis=cfg.sp_axis, causal=True)
         return out.reshape(b, h, s, dh)
     raise ValueError(f"unknown attn_impl: {cfg.attn_impl}")
 

@@ -1,0 +1,87 @@
+"""Sequence/context-parallel training: the LM train step with the sequence
+axis sharded over an ``sp`` mesh axis (ring attention), composable with a
+``dp`` batch axis.
+
+Long-context capability beyond the reference (SURVEY §5): context length is
+bounded by the *mesh*, not one device — activations per device are
+O(S / sp), and attention runs exactly via the K/V ring (parallel/ring.py).
+
+Layout inside the jitted ``shard_map``:
+- params, optimizer state: replicated;
+- x, y: [batch/dp, S/sp] per device;
+- RoPE positions: global offsets, computed from the device's sp index;
+- loss: token-mean over the device shard, then ``pmean`` over the mesh —
+  differentiation through the pmean yields correctly-scaled replicated
+  gradients (the backward's psum rides the same ICI ring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+
+
+def ring_config(cfg: TransformerConfig, sp_axis: str = "sp") -> TransformerConfig:
+    """The same model config with ring attention over ``sp_axis``."""
+    return dataclasses.replace(cfg, attn_impl="ring", sp_axis=sp_axis)
+
+
+def make_sp_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    dp_axis: str | None = "dp",
+    sp_axis: str = "sp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted (dp ×) sp train step: ``(params, opt_state, x, y) ->
+    (params, opt_state, loss)`` with x/y sharded [dp_axis, sp_axis]."""
+    rcfg = ring_config(cfg, sp_axis)
+    axes = tuple(a for a in (dp_axis, sp_axis) if a and a in mesh.shape)
+    if sp_axis not in mesh.shape:
+        raise ValueError(f"mesh {mesh.shape} has no {sp_axis!r} axis")
+    batch_spec = P(dp_axis if dp_axis in mesh.shape else None, sp_axis)
+
+    def local_step(params, opt_state, x, y):
+        s_local = x.shape[-1]
+        positions = jax.lax.axis_index(sp_axis) * s_local + jnp.arange(s_local)
+
+        def loss_fn(p):
+            logits = transformer_lm(p, x, rcfg, positions=positions)
+            return jax.lax.pmean(cross_entropy(logits, y), axes)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        return params, opt_state, loss
+
+    step = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec, batch_spec),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def shard_batch_sp(mesh: Mesh, *arrays, dp_axis: str | None = "dp",
+                   sp_axis: str = "sp"):
+    """Place [B, S] host arrays with batch over dp and sequence over sp."""
+    from jax.sharding import NamedSharding
+
+    spec = P(dp_axis if dp_axis and dp_axis in mesh.shape else None, sp_axis)
+    sh = NamedSharding(mesh, spec)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,154 @@
+"""Tensor parallelism: Megatron-style sharding of the Transformer over a
+``tp`` mesh axis — expressed as GSPMD sharding annotations, not hand-written
+collectives.
+
+This is capability *beyond* the reference (which implements DP only —
+SURVEY §2.3) but required of a complete TPU framework: the mesh/named-axis
+design must treat parallelism strategy as a first-class axis.
+
+TPU-first design: on GPU, Megatron TP is hand-written f/g conjugate
+collective pairs (all-reduce in forward of the row-parallel matmul,
+all-reduce in backward of the column-parallel one). On TPU the idiomatic
+equivalent is *sharding annotation + GSPMD*: declare
+
+- attention q/k/v projections column-parallel (head dim sharded over tp),
+- attention output projection row-parallel,
+- SwiGLU w1/w3 column-parallel (d_ff sharded), w2 row-parallel,
+- LM head column-parallel (vocab sharded; the cross-entropy reduction over
+  the vocab axis is partial-summed by XLA automatically),
+
+and ``jit`` inserts exactly those all-reduces (as fused, latency-hidden
+collectives over the ICI) from the sharding propagation. Composes freely
+with the ``dp`` batch axis: one jit, a 2-D mesh, zero code forks.
+
+Because every attention tensor is sharded on the *head* axis, ``num_heads``
+must divide evenly by the tp degree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cs336_systems_tpu.models.transformer import TransformerConfig
+from cs336_systems_tpu.ops.nn import clip_gradients
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+
+
+def validate_tp(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp") -> None:
+    """Degree checks. GSPMD would still compute *correctly* with ragged
+    sharding (it is only a layout), but head-misaligned attention sharding
+    forces resharding collectives inside every block — reject it."""
+    tp = mesh.shape[axis]
+    if cfg.num_heads % tp:
+        raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
+    if cfg.d_ff % tp:
+        raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
+    if cfg.vocab_size % tp:
+        raise ValueError(f"vocab_size={cfg.vocab_size} not divisible by tp={tp}")
+
+
+def param_specs(cfg: TransformerConfig, axis: str = "tp"):
+    """PartitionSpec pytree for the LM params (blocks stacked on a leading
+    layer axis, so block weights are rank-3: [L, d_out, d_in])."""
+    col = P(None, axis, None)  # output dim sharded (column-parallel)
+    row = P(None, None, axis)  # input dim sharded (row-parallel)
+    rep = P(None, None)
+    return {
+        "token_embeddings": {"weight": P(None, None)},
+        "blocks": {
+            "ln1": {"weight": rep},
+            "attn": {
+                "q_proj": {"weight": col},
+                "k_proj": {"weight": col},
+                "v_proj": {"weight": col},
+                "output_proj": {"weight": row},
+            },
+            "ln2": {"weight": rep},
+            "ffn": {
+                "w1": {"weight": col},
+                "w3": {"weight": col},
+                "w2": {"weight": row},
+            },
+        },
+        "ln_final": {"weight": P(None)},
+        "lm_head": {"weight": P(axis, None)},  # vocab column-parallel
+    }
+
+
+def opt_state_specs(cfg: TransformerConfig, axis: str = "tp"):
+    """AdamW moments shard exactly like their parameters."""
+    ps = param_specs(cfg, axis)
+    return {"m": ps, "v": ps, "t": P()}
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "tp"):
+    """Place a (replicated/host) param pytree into its TP layout."""
+    specs = param_specs(cfg, axis)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def make_tp_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    dp_axis: str | None = "dp",
+    tp_axis: str = "tp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted (dp ×) tp LM train step: params/moments sharded over
+    ``tp_axis``, batch sharded over ``dp_axis`` (if the mesh has one).
+
+    Gradient averaging over dp and the TP matmul all-reduces are both
+    GSPMD-inserted: the jitted step is a single XLA program in which the
+    backward's gradient collectives overlap with remaining compute — the
+    property the reference builds by hand with async NCCL hooks.
+    """
+    from cs336_systems_tpu.train import lm_loss
+
+    validate_tp(cfg, mesh, tp_axis)
+    pspecs = param_specs(cfg, tp_axis)
+    ospecs = opt_state_specs(cfg, tp_axis)
+    bspec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
+    sh = lambda spec: jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(sh(pspecs), sh(ospecs), sh(bspec), sh(bspec)),
+        out_shardings=(sh(pspecs), sh(ospecs), sh(P())),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def tp_param_bytes_per_device(params, mesh: Mesh, cfg: TransformerConfig,
+                              axis: str = "tp") -> int:
+    """Expected per-device param bytes under the TP layout (for tests and
+    memory accounting): sharded leaves divide by the tp degree."""
+    specs = param_specs(cfg, axis)
+    tp = mesh.shape[axis]
+    total = 0
+    for leaf, spec in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(specs, is_leaf=lambda s: isinstance(s, P)),
+    ):
+        nbytes = leaf.size * leaf.dtype.itemsize
+        total += nbytes // tp if any(s == axis for s in spec) else nbytes
+    return total

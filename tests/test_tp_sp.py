@@ -1,0 +1,204 @@
+"""Tensor-parallel and sequence-parallel (ring attention) tests.
+
+All run on the 8-virtual-device CPU mesh (conftest). The oracles are
+single-device computations: TP/SP must be numerically equivalent layouts,
+not approximations.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from common import trees_allclose
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+    transformer_lm,
+)
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.parallel.ring import ring_attention_with_lse
+from cs336_systems_tpu.parallel.sp import make_sp_train_step, shard_batch_sp
+from cs336_systems_tpu.parallel.tp import (
+    make_tp_train_step,
+    param_specs,
+    shard_params,
+    tp_param_bytes_per_device,
+)
+from cs336_systems_tpu.train import make_train_step
+
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=32, d_model=32,
+    num_layers=2, num_heads=4, d_ff=64,
+)
+
+
+def _data(key, batch=4, ctx=32):
+    x = jax.random.randint(key, (batch, ctx), 0, CFG.vocab_size)
+    return x, jnp.roll(x, -1, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    """Exactness: ring over sp=4 == dense attention on the full sequence."""
+    mesh = make_mesh({"sp": 4})
+    b, s, d = 2, 64, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, d)) for kk in ks)
+
+    def local(q, k, v):
+        return ring_attention_with_lse(q, k, v, axis="sp", causal=causal)
+
+    out, lse = jax.jit(
+        jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=(P(None, "sp"), P(None, "sp")),
+        )
+    )(q, k, v)
+
+    scores = jnp.einsum("bqd,bkd->bqk", q, k) / jnp.sqrt(d)
+    if causal:
+        scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -1e30)
+    ref = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(scores, -1), v)
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    mesh = make_mesh({"sp": 4})
+    b, s, d = 2, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, d)) for kk in ks)
+
+    def ring_loss(q, k, v):
+        def local(q, k, v):
+            out, _ = ring_attention_with_lse(q, k, v, axis="sp", causal=True)
+            return jax.lax.psum(jnp.sum(jnp.square(out.astype(jnp.float32))), "sp")
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(),
+        )(q, k, v)
+
+    def dense_loss(q, k, v):
+        scores = jnp.einsum("bqd,bkd->bqk", q, k) / jnp.sqrt(d)
+        scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -1e30)
+        out = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(scores, -1), v)
+        return jnp.sum(jnp.square(out))
+
+    g_ring = jax.jit(jax.grad(ring_loss, (0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(dense_loss, (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# SP train step
+
+
+def test_sp_train_step_matches_single_device():
+    """One dp×sp step == one single-device step on the same global batch."""
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    params, opt = init_transformer_lm(jax.random.PRNGKey(0), CFG), None
+    from cs336_systems_tpu.optim.adamw import adamw_init
+
+    opt = adamw_init(params)
+    hp = AdamWHparams(lr=1e-3)
+    x, y = _data(jax.random.PRNGKey(1))
+
+    ref_step = make_train_step(CFG, hp, clip_norm=1.0, donate=False)
+    p_ref, o_ref, l_ref = ref_step(params, opt, x, y)
+
+    sp_step = make_sp_train_step(CFG, hp, mesh, clip_norm=1.0, donate=False)
+    xs, ys = shard_batch_sp(mesh, x, y)
+    p_sp, o_sp, l_sp = sp_step(params, opt, xs, ys)
+
+    np.testing.assert_allclose(float(l_sp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_sp, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sp_only_mesh_no_dp_axis():
+    mesh = make_mesh({"sp": 4})
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    from cs336_systems_tpu.optim.adamw import adamw_init
+
+    opt = adamw_init(params)
+    step = make_sp_train_step(CFG, AdamWHparams(lr=1e-3), mesh, donate=False)
+    x, y = _data(jax.random.PRNGKey(2), batch=2)
+    xs, ys = shard_batch_sp(mesh, x, y)
+    _, _, loss = step(params, opt, xs, ys)
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# TP train step
+
+
+def test_tp_param_sharding_layout():
+    mesh = make_mesh({"tp": 4})
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    sharded = shard_params(params, mesh, CFG)
+    qw = sharded["blocks"]["attn"]["q_proj"]["weight"]
+    # column-parallel: d_out (axis 1 of [L, d_out, d_in]) split 4 ways
+    assert qw.sharding.spec == P(None, "tp", None)
+    shard_shapes = {tuple(s.data.shape) for s in qw.addressable_shards}
+    assert shard_shapes == {(CFG.num_layers, CFG.d_model // 4, CFG.d_model)}
+    # accounting helper agrees with an actual leaf walk
+    assert tp_param_bytes_per_device(params, mesh, CFG) < sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(params)
+    )
+
+
+def test_tp_forward_matches_single_device():
+    mesh = make_mesh({"tp": 4})
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    x, _ = _data(jax.random.PRNGKey(3))
+    ref = transformer_lm(params, x, CFG)
+
+    sharded = shard_params(params, mesh, CFG)
+    out = jax.jit(lambda p, i: transformer_lm(p, i, CFG))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("axes", [{"tp": 4}, {"dp": 2, "tp": 4}])
+def test_tp_train_step_matches_single_device(axes):
+    mesh = make_mesh(axes)
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    from cs336_systems_tpu.optim.adamw import adamw_init
+
+    opt = adamw_init(params)
+    hp = AdamWHparams(lr=1e-3)
+    x, y = _data(jax.random.PRNGKey(4))
+
+    ref_step = make_train_step(CFG, hp, clip_norm=1.0, donate=False)
+    p_ref, o_ref, l_ref = ref_step(params, opt, x, y)
+
+    tp_step = make_tp_train_step(CFG, hp, mesh, clip_norm=1.0, donate=False)
+    p_tp, o_tp, l_tp = tp_step(params, opt, x, y)
+
+    np.testing.assert_allclose(float(l_tp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_tp, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_requires_divisible_degrees():
+    """GSPMD would compute correctly with ragged sharding, but the step
+    builder rejects head/ff/vocab-misaligned TP degrees up front."""
+    from cs336_systems_tpu.parallel.tp import validate_tp
+
+    mesh = make_mesh({"tp": 4})
+    bad_cfg = dataclasses.replace(CFG, num_heads=2, d_model=32)
+    with pytest.raises(ValueError, match="num_heads"):
+        make_tp_train_step(bad_cfg, AdamWHparams(), mesh)
+    validate_tp(CFG, mesh)  # aligned config passes
