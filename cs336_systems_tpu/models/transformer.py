@@ -10,9 +10,12 @@ TPU-first design (NOT a port of the nn.Module graph):
 
 - Params are a plain pytree; the apply function is pure, so ``jax.jit``,
   ``jax.grad``, ``shard_map`` and ``jax.checkpoint`` compose for free.
-- All N blocks are *stacked* along a leading layer axis and iterated with
-  ``lax.scan`` — one compiled block body regardless of depth, keeping
-  compile time flat and letting XLA pipeline weight prefetch from HBM.
+- All N blocks are *stacked* along a leading layer axis. With
+  ``scan_layers=True`` they are iterated with ``lax.scan`` — one compiled
+  block body regardless of depth, compile time flat. With ``scan_layers=
+  False`` the loop is unrolled: more HLO, but the backward reads each
+  layer's activations in place instead of stashing them into stacked
+  buffers via dynamic-update-slice — measurably faster at small depth.
 - ``compute_dtype=bfloat16`` gives mixed precision (MXU-native) while
   params/norms/softmax/CE stay fp32.
 - The attention inner op is pluggable: ``xla`` (fused naive), ``flash``
@@ -56,8 +59,9 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
-    attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref" | "ring"
+    attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref" | "flash_xla" | "ring"
     remat: bool = False  # rematerialise each block in backward
+    scan_layers: bool = True  # lax.scan over blocks vs unrolled python loop
     sp_axis: str | None = None  # mesh axis of the sequence shard ("ring" only)
 
     def __post_init__(self):
@@ -65,7 +69,7 @@ class TransformerConfig:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
             )
-        if self.attn_impl not in ("xla", "flash", "flash_ref", "ring"):
+        if self.attn_impl not in ("xla", "flash", "flash_ref", "flash_xla", "ring"):
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
         if self.attn_impl == "ring" and not self.sp_axis:
             raise ValueError("attn_impl='ring' requires sp_axis")
@@ -172,15 +176,15 @@ def _attention(q, k, v, cfg: TransformerConfig):
         mask = causal_mask(q.shape[-2], k.shape[-2])
         out, _ = attention_with_lse(q, k, v, mask)
         return out
-    elif cfg.attn_impl in ("flash", "flash_ref"):
+    elif cfg.attn_impl in ("flash", "flash_ref", "flash_xla"):
         from cs336_systems_tpu.ops.flash_attention import flash_attention
 
+        impl = {"flash": "pallas", "flash_ref": "reference", "flash_xla": "xla"}[
+            cfg.attn_impl
+        ]
         b, h, s, dh = q.shape
         fold = lambda x: x.reshape(b * h, s, dh)
-        out = flash_attention(
-            fold(q), fold(k), fold(v), causal=True,
-            impl="pallas" if cfg.attn_impl == "flash" else "reference",
-        )
+        out = flash_attention(fold(q), fold(k), fold(v), causal=True, impl=impl)
         return out.reshape(b, h, s, dh)
     elif cfg.attn_impl == "ring":
         # sequence-parallel exact attention: must be called inside a
@@ -237,9 +241,10 @@ def transformer_lm(
 ) -> jax.Array:
     """Forward pass: [B, S] int ids → [B, S, vocab] logits (compute dtype).
 
-    Layers run under ``lax.scan`` over the stacked block params; with
-    ``cfg.remat`` each block is wrapped in ``jax.checkpoint`` so the backward
-    pass recomputes activations instead of storing S×L of them (HBM trade).
+    Layers run under ``lax.scan`` over the stacked block params
+    (``cfg.scan_layers``) or as an unrolled loop; with ``cfg.remat`` each
+    block is wrapped in ``jax.checkpoint`` so the backward pass recomputes
+    activations instead of storing S×L of them (HBM trade).
     """
     if token_ids.ndim == 1:
         token_ids = token_ids[None, :]
@@ -251,13 +256,30 @@ def transformer_lm(
     with jax.named_scope("embed"):
         x = embedding(params["token_embeddings"], token_ids, cfg.cdtype)
 
-    def body(carry, bp):
-        return _block(bp, carry, cos, sin, positions, cfg), None
+    if cfg.scan_layers:
+        # One compiled block body for any depth; backward stashes activations
+        # into stacked [L, ...] buffers via dynamic-update-slice.
+        def body(carry, bp):
+            return _block(bp, carry, cos, sin, positions, cfg), None
 
-    if cfg.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
-    with jax.named_scope("blocks"):
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+        if cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        with jax.named_scope("blocks"):
+            x, _ = jax.lax.scan(body, x, params["blocks"])
+    else:
+        # Unrolled: more HLO and compile time, but the backward reads each
+        # layer's activations where they were produced — no stash copies.
+        # ~20% faster per step than scan at small depth (measured on v5e).
+        blk = _block
+        if cfg.remat:
+            # prevent_cse must stay True here: outside lax.scan XLA CSE would
+            # merge the forward and recomputed activations, silently undoing
+            # the rematerialization.
+            blk = jax.checkpoint(blk, static_argnums=(5,))
+        with jax.named_scope("blocks"):
+            for i in range(cfg.num_layers):
+                bp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+                x = blk(bp, x, cos, sin, positions, cfg)
 
     with jax.named_scope("final_norm"):
         x = rmsnorm(params["ln_final"], x)

@@ -296,6 +296,20 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool):
 # Public API with custom VJP
 
 
+def _flash_fwd_xla(q, k, v, causal: bool):
+    """Un-tiled fused forward for short sequences: one XLA einsum chain.
+
+    Materializes the [B, n_q, n_k] score matrix *inside* the jit (fused, never
+    a residual — only (O, L) are saved by the custom VJP, so the autograd
+    memory contract matches the tiled kernels). At S ≲ 1-2k this beats the
+    Pallas grid on TPU; the tiled paths take over where S×S no longer fits.
+    """
+    from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
+
+    mask = causal_mask(q.shape[1], k.shape[1]) if causal else None
+    return attention_with_lse(q, k, v, mask)
+
+
 def _flash_forward(q, k, v, causal, impl, q_tile, k_tile):
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
@@ -303,6 +317,8 @@ def _flash_forward(q, k, v, causal, impl, q_tile, k_tile):
         return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile)
     elif impl == "reference":
         return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile)
+    elif impl == "xla":
+        return _flash_fwd_xla(q, k, v, causal)
     raise ValueError(f"unknown flash impl: {impl!r}")
 
 
@@ -356,9 +372,10 @@ def flash_attention(
     """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
 
     ``impl``: "pallas" (TPU kernel; interpreter on CPU), "reference"
-    (portable lax.scan tiling), or "auto" (pallas on TPU else reference).
-    Leading batch dims are folded; 2-D inputs get a singleton batch like the
-    reference host side (flash_attention.py:92-99).
+    (portable lax.scan tiling), "xla" (un-tiled fused forward — fastest for
+    short S, same LSE-only residual contract), or "auto" (pallas on TPU else
+    reference). Leading batch dims are folded; 2-D inputs get a singleton
+    batch like the reference host side (flash_attention.py:92-99).
     """
     return _folded_call(q, k, v, causal, impl, q_tile, k_tile)[0]
 

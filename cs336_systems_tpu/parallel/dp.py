@@ -35,8 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig
-from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
-from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+from cs336_systems_tpu.optim.adamw import AdamWHparams
 
 VARIANTS = ("naive", "flat", "bucketed")
 
@@ -171,18 +170,17 @@ def make_dp_train_step(
     gradient so DP training is step-equivalent to large-batch single-device
     training.
     """
-    from cs336_systems_tpu.train import lm_loss
+    from cs336_systems_tpu.train import lm_loss, make_update_fn
 
-    def local_step(params, opt_state, x, y):
+    def synced_vag(params, x, y):
         vag = local_value_and_grad(lambda p, xx, yy: lm_loss(p, xx, yy, cfg), axis)
         loss, grads = vag(params, x, y)
         grads = sync_grads(grads, axis, variant, bucket_size_mb)
-        loss = jax.lax.pmean(loss, axis)
-        if clip_norm is not None:
-            grads = clip_gradients(grads, clip_norm)
-        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
-        return params, opt_state, loss
+        return jax.lax.pmean(loss, axis), grads
+
+    local_step = make_update_fn(
+        None, hp, clip_norm, lr_schedule, value_and_grad=synced_vag
+    )
 
     step = jax.shard_map(
         local_step,

@@ -76,31 +76,39 @@ def ring_attention_with_lse(
     q_pos = idx * s_local + jnp.arange(s_local)
     perm = [(i, (i + 1) % w) for i in range(w)]  # send my block to the right
 
-    def hop(carry_kv, step):
-        (m, l, acc), (k_blk, v_blk) = carry_kv
-        # after `step` hops I hold the block originally on device idx-step
-        blk_owner = (idx - step) % w
-        k_pos = blk_owner * s_local + jnp.arange(s_local)
-
+    def make_attend(step):
         def attend(m, l, acc, k_blk, v_blk):
+            # after `step` hops I hold the block originally on device idx-step
+            blk_owner = (idx - step) % w
+            k_pos = blk_owner * s_local + jnp.arange(s_local)
             return _block_update(
                 (m, l, acc), q, k_blk, v_blk, q_pos, k_pos, causal, scale, in_dtype
             )
 
-        if remat_steps:
-            attend = jax.checkpoint(attend)
-        m, l, acc = attend(m, l, acc, k_blk, v_blk)
-        k_blk = jax.lax.ppermute(k_blk, axis, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis, perm)
-        return ((m, l, acc), (k_blk, v_blk)), None
+        return jax.checkpoint(attend) if remat_steps else attend
 
-    # Fresh fp32 constants would be device-invariant, but the scan carry
-    # becomes axis-varying after one hop — derive the init state from q so
+    # Fresh fp32 constants would be device-invariant, but the state becomes
+    # axis-varying after the first block — derive the init state from q so
     # it inherits exactly q's varying axes (sp, and dp when present).
     acc0 = q.astype(jnp.float32) * 0.0
     l0 = acc0[..., 0]
-    init = ((l0 + _NEG_INF, l0, acc0), (k, v))
-    ((m, l, acc), _), _ = jax.lax.scan(hop, init, jnp.arange(w))
+
+    # Hop 0 attends the local block with no communication; each later hop
+    # permutes first, then attends — so exactly w-1 ppermutes total and the
+    # last received block is actually used (no discarded final rotation).
+    m, l, acc = make_attend(0)(l0 + _NEG_INF, l0, acc0, k, v)
+
+    def hop(carry_kv, step):
+        (m, l, acc), (k_blk, v_blk) = carry_kv
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        m, l, acc = make_attend(step)(m, l, acc, k_blk, v_blk)
+        return ((m, l, acc), (k_blk, v_blk)), None
+
+    if w > 1:
+        ((m, l, acc), _), _ = jax.lax.scan(
+            hop, ((m, l, acc), (k, v)), jnp.arange(1, w)
+        )
 
     safe_l = jnp.where(l > 0.0, l, 1.0)
     out = (acc / safe_l[..., None]).astype(in_dtype)

@@ -25,8 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
-from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
-from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+from cs336_systems_tpu.ops.nn import cross_entropy
+from cs336_systems_tpu.optim.adamw import AdamWHparams
 
 
 def ring_config(cfg: TransformerConfig, sp_axis: str = "sp") -> TransformerConfig:
@@ -52,20 +52,27 @@ def make_sp_train_step(
         raise ValueError(f"mesh {mesh.shape} has no {sp_axis!r} axis")
     batch_spec = P(dp_axis if dp_axis in mesh.shape else None, sp_axis)
 
-    def local_step(params, opt_state, x, y):
+    from cs336_systems_tpu.train import make_update_fn
+
+    sp_degree = mesh.shape[sp_axis]
+
+    def sharded_loss(p, x, y):
         s_local = x.shape[-1]
+        # Global positions index the RoPE cache; past cfg.context_length the
+        # gather goes out of bounds and jnp.take's NaN fill would be silently
+        # swallowed by attention's masked-row guard — reject at trace time.
+        if sp_degree * s_local > cfg.context_length:
+            raise ValueError(
+                f"global sequence {sp_degree}*{s_local}="
+                f"{sp_degree * s_local} exceeds context_length="
+                f"{cfg.context_length}; raise cfg.context_length to cover "
+                "the full sharded sequence"
+            )
         positions = jax.lax.axis_index(sp_axis) * s_local + jnp.arange(s_local)
+        logits = transformer_lm(p, x, rcfg, positions=positions)
+        return jax.lax.pmean(cross_entropy(logits, y), axes)
 
-        def loss_fn(p):
-            logits = transformer_lm(p, x, rcfg, positions=positions)
-            return jax.lax.pmean(cross_entropy(logits, y), axes)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        if clip_norm is not None:
-            grads = clip_gradients(grads, clip_norm)
-        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
-        return params, opt_state, loss
+    local_step = make_update_fn(sharded_loss, hp, clip_norm, lr_schedule)
 
     step = jax.shard_map(
         local_step,

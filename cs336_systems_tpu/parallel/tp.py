@@ -34,8 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig
-from cs336_systems_tpu.ops.nn import clip_gradients
-from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_update
+from cs336_systems_tpu.optim.adamw import AdamWHparams
 
 
 def validate_tp(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp") -> None:
@@ -111,7 +110,9 @@ def make_tp_train_step(
     backward's gradient collectives overlap with remaining compute — the
     property the reference builds by hand with async NCCL hooks.
     """
-    from cs336_systems_tpu.train import lm_loss
+    import functools
+
+    from cs336_systems_tpu.train import lm_loss, make_update_fn
 
     validate_tp(cfg, mesh, tp_axis)
     pspecs = param_specs(cfg, tp_axis)
@@ -122,13 +123,9 @@ def make_tp_train_step(
         is_leaf=lambda s: isinstance(s, P),
     )
 
-    def step(params, opt_state, x, y):
-        loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
-        if clip_norm is not None:
-            grads = clip_gradients(grads, clip_norm)
-        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
-        return params, opt_state, loss
+    step = make_update_fn(
+        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+    )
 
     return jax.jit(
         step,

@@ -25,6 +25,44 @@ def lm_loss(params, x, y, cfg: TransformerConfig):
     return cross_entropy(logits, y)
 
 
+def make_update_fn(
+    loss_fn: Callable | None,
+    hp: AdamWHparams,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    *,
+    value_and_grad: Callable | None = None,
+) -> Callable:
+    """The one canonical step body: ``(params, opt_state, x, y) ->
+    (params, opt_state, loss)``.
+
+    value_and_grad → optional global-norm clip → optional LR schedule on the
+    step counter → AdamW. The single-device step, the multi-step loop, and
+    the DP / TP / SP-ring train-step builders all wrap THIS function, so the
+    update semantics — clip placement, schedule indexing, decay arithmetic —
+    cannot drift between those variants. (ZeRO-1 is the one exception: it
+    re-expresses the same update on reduce-scattered flat chunks, and its
+    bit-exactness against the unsharded path is pinned by test instead.)
+
+    ``loss_fn``: ``(params, x, y) -> scalar loss``. Distributed variants that
+    must own their gradient communication pass ``value_and_grad`` instead —
+    ``(params, x, y) -> (loss, grads)`` with any collective sync already
+    applied (e.g. DP's explicit pmean variants).
+    """
+    if value_and_grad is None:
+        value_and_grad = jax.value_and_grad(loss_fn)
+
+    def update(params, opt_state, x, y):
+        loss, grads = value_and_grad(params, x, y)
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        return params, opt_state, loss
+
+    return update
+
+
 def make_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,
@@ -38,16 +76,46 @@ def make_train_step(
     consumed by the update anyway), halving the step's HBM high-water mark.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
-    def step(params, opt_state, x, y):
-        loss, grads = jax.value_and_grad(lm_loss)(params, x, y, cfg)
-        if clip_norm is not None:
-            grads = clip_gradients(grads, clip_norm)
-        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
-        return params, opt_state, loss
+    update = make_update_fn(
+        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+    )
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 
-    return step
+
+def make_train_loop(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    donate: bool = True,
+) -> Callable:
+    """Build a jitted ``(params, opt_state, xs, ys) -> (params, opt_state, losses)``
+    running ``xs.shape[0]`` optimizer steps in ONE XLA computation.
+
+    ``lax.scan`` over the step body keeps the whole training loop on-device:
+    a single dispatch per K steps instead of K host round-trips. On TPU this
+    is the idiomatic loop shape — host dispatch latency (several ms through
+    remote runtimes) never gates the chip. ``xs``/``ys``: [K, B, S] int32.
+    """
+
+    update = make_update_fn(
+        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+    )
+
+    def one_step(carry, batch):
+        params, opt_state = carry
+        x, y = batch
+        params, opt_state, loss = update(params, opt_state, x, y)
+        return (params, opt_state), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def loop(params, opt_state, xs, ys):
+        (params, opt_state), losses = jax.lax.scan(
+            one_step, (params, opt_state), (xs, ys)
+        )
+        return params, opt_state, losses
+
+    return loop
 
 
 def make_eval_step(cfg: TransformerConfig) -> Callable:

@@ -20,7 +20,7 @@ from cs336_systems_tpu.ops.flash_attention import (
     flash_attention_with_lse,
 )
 
-IMPLS = ["reference", "pallas"]
+IMPLS = ["reference", "pallas", "xla"]
 
 
 def _make_qkv(key, batch, n_q, n_k, d, dtype=jnp.float32):

@@ -139,6 +139,48 @@ def test_remat_matches_no_remat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+def test_unrolled_layers_match_scan():
+    """scan_layers=False (the TPU benchmark config) must be numerically
+    identical to the lax.scan path, in forward and gradient, with and
+    without remat."""
+    cfg = tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+
+    def loss(p, c):
+        return jnp.mean(transformer_lm(p, x, c) ** 2)
+
+    l_scan = loss(params, cfg)
+    g_scan = jax.grad(loss)(params, cfg)
+    for unrolled in (tiny_cfg(scan_layers=False), tiny_cfg(scan_layers=False, remat=True)):
+        np.testing.assert_allclose(
+            np.asarray(loss(params, unrolled)), np.asarray(l_scan), rtol=1e-5
+        )
+        g = jax.grad(loss)(params, unrolled)
+        for a, b in zip(jax.tree_util.tree_leaves(g_scan), jax.tree_util.tree_leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_xla_attn_impl_in_model():
+    """attn_impl='flash_xla' (benchmark headline config) must match the
+    plain xla attention path."""
+    cfg = tiny_cfg()
+    cfg_fx = tiny_cfg(attn_impl="flash_xla")
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+
+    def loss(p, c):
+        return jnp.mean(transformer_lm(p, x, c) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(loss(params, cfg_fx)), np.asarray(loss(params, cfg)), rtol=1e-5
+    )
+    g1 = jax.grad(loss)(params, cfg)
+    g2 = jax.grad(loss)(params, cfg_fx)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
 def test_count_params_analytic():
     cfg = tiny_cfg()
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)

@@ -129,6 +129,21 @@ def test_sp_train_step_matches_single_device():
     assert trees_allclose(p_sp, p_ref, rtol=1e-4, atol=1e-5)
 
 
+def test_sp_rejects_sequence_beyond_context_length():
+    """Global sequence sp*S_local > context_length must raise at trace time
+    (silent RoPE out-of-bounds garbage otherwise)."""
+    mesh = make_mesh({"sp": 4})
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    opt = adamw_init(params)
+    step = make_sp_train_step(CFG, AdamWHparams(lr=1e-3), mesh, donate=False)
+    # global S = 64 > context_length = 32
+    x = jax.random.randint(jax.random.PRNGKey(3), (2, 64), 0, CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    xs, ys = shard_batch_sp(mesh, x, y)
+    with pytest.raises(ValueError, match="exceeds context_length"):
+        step(params, opt, xs, ys)
+
+
 def test_sp_only_mesh_no_dp_axis():
     mesh = make_mesh({"sp": 4})
     params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
