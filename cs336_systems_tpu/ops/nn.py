@@ -54,12 +54,18 @@ def global_grad_norm(grads) -> jax.Array:
     return jnp.sqrt(total)
 
 
-def clip_gradients(grads, max_norm: float, eps: float = 1e-6):
+def clip_gradients(grads, max_norm: float, eps: float = 1e-6, norm=None):
     """Global-norm gradient clipping on a pytree.
 
     Scale = min(1, max_norm / (norm + eps)) — the reference's formulation
     (nn_utils.py:21-30) — applied functionally (returns a new pytree).
+
+    ``norm``: optional externally computed global norm. Distributed callers
+    whose gradient leaves are device-local shards (e.g. pipeline stages)
+    pass the collective-reduced norm here so the clip FORMULA stays in one
+    place while the norm reduction is theirs.
     """
-    norm = global_grad_norm(grads)
+    if norm is None:
+        norm = global_grad_norm(grads)
     scale = jnp.minimum(1.0, max_norm / (norm + eps))
     return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)

@@ -12,6 +12,11 @@ distributed_communication_single.py):
 - ``dp``          — data-parallel train steps in three collective-granularity
                     variants (naive per-param, flat single-tensor, bucketed).
 - ``zero``        — ZeRO-1: optimizer state sharded over the dp axis.
+- ``tp``          — Megatron-style tensor parallelism via GSPMD shardings.
+- ``sp``/``ring`` — sequence/context parallelism: exact ring attention over
+                    an sp axis (K/V ppermute hops, online-softmax merging).
+- ``pp``          — GPipe pipeline parallelism: layer stages over a pp axis,
+                    microbatches streamed via ppermute inside one jit.
 
 Everything is single-program SPMD under ``jax.shard_map``: one jitted step
 per variant, collectives scheduled (and overlapped with compute) by XLA.

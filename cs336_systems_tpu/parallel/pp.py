@@ -1,0 +1,321 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pp``
+mesh axis.
+
+Capability beyond the reference (which implements DP only — SURVEY §2.3),
+but part of the complete mesh-parallelism surface (dp/tp/sp/pp/ep): depth is
+sharded across devices, so models whose layers do not fit one chip train by
+streaming microbatches through per-device stages.
+
+TPU-first design — no per-rank processes, no send/recv primitives, no
+scheduler threads (the GPU framing of pipeline parallelism). One jitted
+``shard_map`` runs the whole schedule:
+
+- The stacked block params ([L, ...] leaves) are sharded ``P("pp")`` on the
+  layer axis: each device's local view IS its stage (L/W contiguous layers).
+- The batch is split into M microbatches. A ``lax.scan`` over
+  T = M + W - 1 ticks advances the pipeline: each tick, every device runs
+  its stage on its current activation and ``ppermute``s the result to the
+  next stage (one ICI hop). Bubble ticks compute on garbage and are masked
+  at the loss — the standard SPMD fill/drain trade.
+- Stage 0 feeds ``embed(microbatch[t])`` into the ring; the last stage
+  accumulates its outputs, and after the drain computes final-norm + LM head
+  + cross-entropy ONCE over all microbatches (head cost equal to the
+  unpipelined model, not per-tick).
+- Backward is pure autodiff: the transpose of ``ppermute`` is the reverse
+  ppermute, so ``jax.grad`` of the scheduled loss runs the reverse schedule
+  with no hand-written backward. Embedding/head/final-norm gradients are
+  nonzero only on their owning stage; a ``psum`` over ``pp`` restores the
+  replicated gradient. Block gradients (and their AdamW moments) stay
+  sharded over ``pp`` — optimizer state for the depth dimension is
+  partitioned for free, ZeRO-flavored.
+- Composes with a ``dp`` batch axis: gradients of replicated leaves are
+  additionally ``pmean``-ed over dp.
+
+Reference seam being re-expressed: the reference scales only batch (DP);
+its per-layer module loop (model.py:330-386) is here re-cut along the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cs336_systems_tpu.models.layers import embedding, linear, rmsnorm, rope_cache
+from cs336_systems_tpu.models.transformer import TransformerConfig, _block
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+
+
+def validate_pp(cfg: TransformerConfig, mesh: Mesh, axis: str = "pp") -> None:
+    pp = mesh.shape[axis]
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by pp={pp}"
+        )
+
+
+def param_specs(cfg: TransformerConfig, axis: str = "pp"):
+    """Blocks sharded over ``axis`` on the stacked layer dim; everything else
+    replicated."""
+    stage = jax.tree_util.tree_map(
+        lambda _: P(axis),
+        {
+            "ln1": {"weight": 0},
+            "attn": {"q_proj": {"weight": 0}, "k_proj": {"weight": 0},
+                     "v_proj": {"weight": 0}, "output_proj": {"weight": 0}},
+            "ln2": {"weight": 0},
+            "ffn": {"w1": {"weight": 0}, "w2": {"weight": 0}, "w3": {"weight": 0}},
+        },
+    )
+    return {
+        "token_embeddings": {"weight": P()},
+        "blocks": stage,
+        "ln_final": {"weight": P()},
+        "lm_head": {"weight": P()},
+    }
+
+
+def opt_state_specs(cfg: TransformerConfig, axis: str = "pp"):
+    ps = param_specs(cfg, axis)
+    return {"m": ps, "v": ps, "t": P()}
+
+
+def shard_params_pp(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "pp"):
+    specs = param_specs(cfg, axis)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def _stage_apply(blocks_local, h, cos, sin, positions, cfg: TransformerConfig):
+    """Run this device's L/W layers on one microbatch activation.
+
+    ``cfg.remat`` opts into per-block rematerialization, same as the
+    unpipelined model paths."""
+
+    def body(carry, bp):
+        return _block(bp, carry, cos, sin, positions, cfg), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    h, _ = jax.lax.scan(body, h, blocks_local)
+    return h
+
+
+def pipelined_loss(
+    params,
+    x: jax.Array,
+    y: jax.Array,
+    cfg: TransformerConfig,
+    num_microbatches: int,
+    axis: str = "pp",
+    dp_axis: str | None = None,
+):
+    """GPipe-scheduled LM loss; call inside ``shard_map`` with the blocks
+    leaves holding the LOCAL stage ([L/W, ...]).
+
+    x/y: [B_local, S]. Returns this device's MASKED loss contribution
+    (nonzero only on the last stage, pre-divided by the dp degree): the
+    device-sum of these is the global mean loss, which is the objective
+    manual-mode autodiff differentiates. ``psum`` it over the mesh to report
+    the scalar.
+    """
+    w = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    m = num_microbatches
+    b, s = x.shape
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by num_microbatches={m}")
+    mb = b // m
+
+    cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
+    positions = jnp.arange(s)
+    x_mb = x.reshape(m, mb, s)
+
+    perm = [(i, (i + 1) % w) for i in range(w)]  # stage d -> d+1
+
+    def tick(carry, t):
+        h, outs = carry
+        # stage 0 ingests microbatch t (index clipped during drain ticks; a
+        # clipped duplicate never reaches a valid loss slot)
+        feed = embedding(
+            params["token_embeddings"], x_mb[jnp.clip(t, 0, m - 1)], cfg.cdtype
+        )
+        h_in = jnp.where(idx == 0, feed, h)
+        h_out = _stage_apply(params["blocks"], h_in, cos, sin, positions, cfg)
+        # bank the result for microbatch mi = t - (W-1) — only meaningful on
+        # the last stage, and only when mi is a real microbatch index
+        mi = t - (w - 1)
+        valid = (mi >= 0) & (mi < m)
+        banked = jax.lax.dynamic_index_in_dim(outs, jnp.clip(mi, 0, m - 1), 0,
+                                              keepdims=False)
+        new = jnp.where(valid, h_out.astype(outs.dtype), banked)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, new, jnp.clip(mi, 0, m - 1), 0
+        )
+        h_next = jax.lax.ppermute(h_out, axis, perm) if w > 1 else h_out
+        return (h_next, outs), None
+
+    h0 = jnp.zeros((mb, s, cfg.d_model), cfg.cdtype)
+    outs0 = jnp.zeros((m, mb, s, cfg.d_model), cfg.cdtype)
+    ticks = jnp.arange(m + w - 1)
+    (h, outs), _ = jax.lax.scan(tick, (h0, outs0), ticks)
+
+    # Final norm + head + CE once, on the drained buffer; only the last
+    # stage's buffer is real — mask to zero elsewhere. NO collective here:
+    # under manual shard_map AD the implied objective is the SUM of each
+    # device's returned scalar, so the masked local loss (divided by the dp
+    # degree) sums to exactly the global mean loss — a psum inside the
+    # differentiated function would scale every gradient by the device count.
+    # Callers psum this masked value to report the scalar.
+    hidden = outs.reshape(m * mb, s, cfg.d_model)
+    hidden = rmsnorm(params["ln_final"], hidden)
+    logits = linear(params["lm_head"], hidden, cfg.cdtype)
+    loss_local = cross_entropy(logits, y.reshape(m * mb, s))
+    masked = jnp.where(idx == w - 1, loss_local, 0.0)
+    if dp_axis is not None:
+        masked = masked / jax.lax.axis_size(dp_axis)
+    return masked
+
+
+def _make_pp_vag(
+    cfg: TransformerConfig,
+    num_microbatches: int,
+    pp_axis: str,
+    dpa: str | None,
+    pspecs,
+    clip_norm: float | None,
+) -> Callable:
+    """Shared pp value-and-grad body: ``(params, x, y) -> (loss, grads)``
+    inside shard_map. Differentiates the masked local loss, psums the scalar
+    for reporting, restores replicated-leaf grads over pp, completes the dp
+    mean, and (optionally) clips by the collective-reduced global norm."""
+    has_dp = dpa is not None
+
+    def spec_is_sharded(spec):
+        return any(s == pp_axis for s in spec)
+
+    def leaves_with_specs(tree):
+        return zip(
+            jax.tree_util.tree_leaves(tree),
+            jax.tree_util.tree_leaves(pspecs, is_leaf=lambda t: isinstance(t, P)),
+        )
+
+    def vag(params, x, y):
+        masked, grads = jax.value_and_grad(pipelined_loss)(
+            params, x, y, cfg, num_microbatches, pp_axis, dpa
+        )
+        loss = jax.lax.psum(masked, (pp_axis, dpa) if has_dp else pp_axis)
+
+        # Replicated leaves (embed/head/final-norm): their grads live only on
+        # the owning stage — psum over pp restores the replicated value; the
+        # dp psum completes the mean (objective carries the 1/dp factor).
+        def fix(g, spec):
+            if not spec_is_sharded(spec):
+                g = jax.lax.psum(g, pp_axis)
+            if has_dp:
+                g = jax.lax.psum(g, dpa)
+            return g
+
+        grads = jax.tree_util.tree_map(
+            fix, grads, pspecs, is_leaf=lambda t: isinstance(t, P)
+        )
+
+        # Global-norm clip must see the WHOLE gradient: block grads are
+        # pp-local shards, so their squared sum needs a psum over pp before
+        # the norm (the shared make_update_fn clip would compute a
+        # stage-local norm); the clip formula itself is ops.nn's.
+        if clip_norm is not None:
+            sq = lambda g: jnp.sum(jnp.square(g.astype(jnp.float32)))
+            sq_sharded = sum(
+                sq(g) for g, spec in leaves_with_specs(grads) if spec_is_sharded(spec)
+            )
+            sq_replicated = sum(
+                sq(g)
+                for g, spec in leaves_with_specs(grads)
+                if not spec_is_sharded(spec)
+            )
+            norm = jnp.sqrt(jax.lax.psum(sq_sharded, pp_axis) + sq_replicated)
+            grads = clip_gradients(grads, clip_norm, norm=norm)
+        return loss, grads
+
+    return vag
+
+
+def make_pp_grad_fn(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+    dp_axis: str | None = None,
+) -> Callable:
+    """``(params, x, y) -> (loss, grads)`` under the GPipe schedule, grads
+    already pp-restored/dp-averaged (test seam: pipelining must be a
+    *schedule*, so these gradients match the unpipelined model's to fp
+    reassociation)."""
+    validate_pp(cfg, mesh, pp_axis)
+    has_dp = dp_axis is not None and dp_axis in mesh.shape
+    dpa = dp_axis if has_dp else None
+    pspecs = param_specs(cfg, pp_axis)
+    bspec = P(dpa) if has_dp else P()
+
+    local = _make_pp_vag(cfg, num_microbatches, pp_axis, dpa, pspecs, None)
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(pspecs, bspec, bspec),
+            out_specs=(P(), pspecs),
+            check_vma=False,
+        )
+    )
+
+
+def make_pp_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    num_microbatches: int | None = None,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    pp_axis: str = "pp",
+    dp_axis: str | None = "dp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted (dp ×) pp train step: ``(params, opt_state, x, y) ->
+    (params, opt_state, loss)``.
+
+    Params/opt-state blocks sharded over ``pp_axis`` (layer axis), x/y
+    sharded over ``dp_axis`` when the mesh has one. ``num_microbatches``
+    defaults to the pipeline width (minimum bubble-free-ish choice; raise it
+    to shrink the bubble fraction (W-1)/(M+W-1)).
+    """
+    from cs336_systems_tpu.train import make_update_fn
+
+    validate_pp(cfg, mesh, pp_axis)
+    w = mesh.shape[pp_axis]
+    m = num_microbatches if num_microbatches is not None else w
+    has_dp = dp_axis is not None and dp_axis in mesh.shape
+    dpa = dp_axis if has_dp else None
+
+    pspecs = param_specs(cfg, pp_axis)
+    ospecs = opt_state_specs(cfg, pp_axis)
+    bspec = P(dpa) if has_dp else P()
+
+    # Clipping happens inside the shared pp vag (it needs the psum-reduced
+    # norm), so the canonical update body runs with clip disabled.
+    vag = _make_pp_vag(cfg, m, pp_axis, dpa, pspecs, clip_norm)
+    local_step = make_update_fn(None, hp, None, lr_schedule, value_and_grad=vag)
+
+    step = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(pspecs, ospecs, bspec, bspec),
+        out_specs=(pspecs, ospecs, P()),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

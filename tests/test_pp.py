@@ -1,0 +1,134 @@
+"""Pipeline-parallel (GPipe over the ``pp`` mesh axis) tests.
+
+Oracle: the single-device train step — pipelining is a schedule, not an
+approximation, so one dp×pp step must match one full-batch step tightly.
+Runs on the 8-virtual-device CPU mesh (conftest).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from common import trees_allclose
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.parallel.pp import (
+    make_pp_train_step,
+    shard_params_pp,
+    validate_pp,
+)
+from cs336_systems_tpu.train import make_train_step
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=32, d_model=32,
+    num_layers=4, num_heads=4, d_ff=64,
+)
+
+
+def _data(key, batch=8):
+    x = jax.random.randint(key, (batch, CFG.context_length), 0, CFG.vocab_size)
+    return x, jnp.roll(x, -1, axis=-1)
+
+
+def _ref_step_result(x, y, clip_norm=1.0):
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    opt = adamw_init(params)
+    step = make_train_step(CFG, AdamWHparams(lr=1e-3), clip_norm=clip_norm,
+                           donate=False)
+    return step(params, opt, x, y)
+
+
+# Post-AdamW tolerance: with t=1 the update is alpha_t * g/(|g|+eps), so
+# ulp-level fp-reassociation differences in near-zero gradients flip the
+# quotient by up to ~alpha_t = lr*sqrt(1-b2)/(1-b1) ≈ 3.2e-4 at lr=1e-3.
+# Gradients themselves are checked near-exactly in test_pp_grads_*.
+ADAMW_ATOL = 5e-4
+
+
+@pytest.mark.parametrize("num_microbatches", [2, 4])
+def test_pp_grads_match_single_device(num_microbatches):
+    """The GPipe schedule is exact: gradients match the unpipelined model to
+    fp reassociation."""
+    from cs336_systems_tpu.parallel.pp import make_pp_grad_fn
+    from cs336_systems_tpu.train import lm_loss
+
+    mesh = make_mesh({"pp": 4})
+    x, y = _data(jax.random.PRNGKey(1))
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    l_ref, g_ref = jax.value_and_grad(lm_loss)(params, x, y, CFG)
+
+    grad_fn = make_pp_grad_fn(CFG, mesh, num_microbatches)
+    l_pp, g_pp = grad_fn(shard_params_pp(params, mesh, CFG), x, y)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-6)
+    assert trees_allclose(g_pp, g_ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("num_microbatches", [2, 4])
+def test_pp_step_matches_single_device(num_microbatches):
+    mesh = make_mesh({"pp": 4})
+    x, y = _data(jax.random.PRNGKey(1))
+    p_ref, o_ref, l_ref = _ref_step_result(x, y)
+
+    params = shard_params_pp(init_transformer_lm(jax.random.PRNGKey(0), CFG),
+                             mesh, CFG)
+    opt = adamw_init(params)
+    step = make_pp_train_step(CFG, AdamWHparams(lr=1e-3), mesh,
+                              num_microbatches=num_microbatches, donate=False)
+    p_pp, o_pp, l_pp = step(params, opt, x, y)
+
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_pp, p_ref, rtol=1e-3, atol=ADAMW_ATOL)
+
+
+def test_pp_composes_with_dp():
+    """dp=2 × pp=4: batch sharded over dp, layers over pp."""
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    x, y = _data(jax.random.PRNGKey(2))
+    p_ref, o_ref, l_ref = _ref_step_result(x, y)
+
+    params = shard_params_pp(init_transformer_lm(jax.random.PRNGKey(0), CFG),
+                             mesh, CFG)
+    opt = adamw_init(params)
+    step = make_pp_train_step(CFG, AdamWHparams(lr=1e-3), mesh,
+                              num_microbatches=2, donate=False)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("dp"))
+    p_pp, o_pp, l_pp = step(params, opt, jax.device_put(x, sh),
+                            jax.device_put(y, sh))
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_pp, p_ref, rtol=1e-3, atol=ADAMW_ATOL)
+
+
+def test_pp_single_stage_degenerates_to_plain_step():
+    mesh = make_mesh({"pp": 1})
+    x, y = _data(jax.random.PRNGKey(3), batch=4)
+    p_ref, o_ref, l_ref = _ref_step_result(x, y)
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    opt = adamw_init(params)
+    step = make_pp_train_step(CFG, AdamWHparams(lr=1e-3), mesh,
+                              num_microbatches=2, dp_axis=None, donate=False)
+    p_pp, _, l_pp = step(params, opt, x, y)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_pp, p_ref, rtol=1e-3, atol=ADAMW_ATOL)
+
+
+def test_pp_validation():
+    mesh = make_mesh({"pp": 8})
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_pp(CFG, mesh)  # 4 layers, pp=8
+
+    mesh4 = make_mesh({"pp": 4})
+    step = make_pp_train_step(CFG, AdamWHparams(lr=1e-3), mesh4,
+                              num_microbatches=3, dp_axis=None, donate=False)
+    params = shard_params_pp(init_transformer_lm(jax.random.PRNGKey(0), CFG),
+                             mesh4, CFG)
+    opt = adamw_init(params)
+    x, y = _data(jax.random.PRNGKey(4))  # batch 8 not divisible by m=3
+    with pytest.raises(ValueError, match="not divisible"):
+        step(params, opt, x, y)
