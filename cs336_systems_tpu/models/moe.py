@@ -1,0 +1,119 @@
+"""Mixture-of-Experts SwiGLU feed-forward with top-k routing.
+
+A second model family beyond the reference's dense Transformer (the
+reference has no MoE anywhere — this is part of the complete framework
+surface, and the substrate for expert parallelism in ``parallel/ep.py``).
+
+TPU-first design — GShard/Mesh-TensorFlow style DENSE dispatch:
+
+- No scatters, no ragged shapes, no host-side routing: the router builds
+  one-hot dispatch/combine tensors [T, E, C] (T tokens, E experts, C
+  capacity slots) and the whole layer is three einsums + a vmapped expert
+  SwiGLU — everything lands on the MXU with static shapes, which is exactly
+  what XLA needs. Tokens over capacity are dropped (their combine weight is
+  zero and the residual stream carries them through), the standard
+  capacity-factor trade; a sort-based dropless dispatch is the documented
+  upgrade for very large T·E·C.
+- Routing runs in fp32 (softmax over expert logits) regardless of the
+  compute dtype; expert weights match the dense SwiGLU init so a 1-expert
+  MoE is numerically the dense layer.
+- The load-balancing auxiliary loss is the GShard formulation:
+  ``E · Σ_e mean_tokens(gate_e) · mean_tokens(is_top1_e)`` — differentiable
+  through the gate term.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear
+
+
+def init_moe(key, d_model: int, d_ff: int, num_experts: int, dtype=jnp.float32):
+    """Router + E stacked expert SwiGLUs (leaves [E, ...])."""
+    k_router, k_experts = jax.random.split(key)
+    expert_keys = jax.random.split(k_experts, num_experts)
+    experts = jax.vmap(lambda k: init_swiglu(k, d_model, d_ff, dtype))(expert_keys)
+    return {
+        "router": init_linear(k_router, d_model, num_experts, dtype),
+        "experts": experts,
+    }
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert capacity C = ceil(k·T/E · factor), floored at top_k."""
+    return max(top_k, math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+
+
+def route_topk(gates: jax.Array, top_k: int, capacity: int):
+    """Build dispatch/combine tensors from gate probabilities.
+
+    ``gates``: [T, E] fp32 probabilities. Returns
+    ``(dispatch [T,E,C] bool-ish fp32, combine [T,E,C] fp32, aux scalar)``.
+
+    Slot j=0 (the top-1 choice) claims capacity before j=1, etc., so lower-
+    priority assignments are the ones dropped under pressure — the GShard
+    ordering. Positions within an expert's queue follow token order.
+    """
+    t, e = gates.shape
+    vals, idx = jax.lax.top_k(gates, top_k)  # [T, k]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    fill = jnp.zeros((e,), jnp.int32)  # running per-expert occupancy
+    for j in range(top_k):  # top_k is small and static
+        onehot_e = jax.nn.one_hot(idx[:, j], e, dtype=jnp.float32)  # [T, E]
+        # position this token would take in each expert's queue
+        pos_if = jnp.cumsum(onehot_e, axis=0) - 1.0 + fill[None, :].astype(jnp.float32)
+        pos = jnp.sum(pos_if * onehot_e, axis=-1)  # [T]
+        keep = (pos < capacity) & (pos >= 0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        assigned = onehot_e[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + assigned
+        combine = combine + assigned * vals[:, j][:, None, None]
+        fill = fill + jnp.sum(onehot_e, axis=0).astype(jnp.int32)
+
+    # GShard load-balancing aux: E * sum_e mean(gate_e) * mean(top1_e)
+    top1 = jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(gates, axis=0) * jnp.mean(top1, axis=0))
+    return dispatch, combine, aux
+
+
+def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
+            compute_dtype=None):
+    """MoE SwiGLU: [..., S, D] -> ([..., S, D], aux loss scalar).
+
+    Three einsums around a vmapped expert SwiGLU:
+    dispatch ([T,E,C] × [T,D] → [E,C,D]) → experts → combine back.
+    """
+    from cs336_systems_tpu.models.layers import swiglu
+
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)  # [T, D]
+    t = xt.shape[0]
+    e = params["router"]["weight"].shape[0]
+    c = moe_capacity(t, e, top_k, capacity_factor)
+
+    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E] fp32
+    dispatch, combine, aux = route_topk(gates, top_k, c)
+
+    in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+    xe = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(in_dtype), xt.astype(in_dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(in_dtype)  # [E, C, D]
+
+    ye = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))(params["experts"], xe)
+
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(jnp.float32), ye.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(in_dtype)
+    return out.reshape(*lead, d), aux

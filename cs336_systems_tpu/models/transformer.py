@@ -63,6 +63,12 @@ class TransformerConfig:
     remat: bool = False  # rematerialise each block in backward
     scan_layers: bool = True  # lax.scan over blocks vs unrolled python loop
     sp_axis: str | None = None  # mesh axis of the sequence shard ("ring" only)
+    # Mixture-of-Experts FFN (0 = dense SwiGLU; >0 = that many experts in
+    # every block, top-k routed — see models/moe.py)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance aux loss weight in lm_loss
 
     def __post_init__(self):
         if self.d_model % self.num_heads != 0:
@@ -73,6 +79,10 @@ class TransformerConfig:
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
         if self.attn_impl == "ring" and not self.sp_axis:
             raise ValueError("attn_impl='ring' requires sp_axis")
+        if self.num_experts > 0 and self.moe_top_k > self.num_experts:
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} > num_experts={self.num_experts}"
+            )
 
     @property
     def d_head(self) -> int:
@@ -131,6 +141,12 @@ def config_for_size(
 def _init_block(key, cfg: TransformerConfig):
     kq, kk, kv, ko, kffn = jax.random.split(key, 5)
     d = cfg.d_model
+    if cfg.num_experts > 0:
+        from cs336_systems_tpu.models.moe import init_moe
+
+        ffn = init_moe(kffn, d, cfg.d_ff, cfg.num_experts, cfg.pdtype)
+    else:
+        ffn = init_swiglu(kffn, d, cfg.d_ff, cfg.pdtype)
     return {
         "ln1": init_rmsnorm(d, cfg.pdtype),
         "attn": {
@@ -140,7 +156,7 @@ def _init_block(key, cfg: TransformerConfig):
             "output_proj": init_linear(ko, d, d, cfg.pdtype),
         },
         "ln2": init_rmsnorm(d, cfg.pdtype),
-        "ffn": init_swiglu(kffn, d, cfg.d_ff, cfg.pdtype),
+        "ffn": ffn,
     }
 
 
@@ -224,24 +240,38 @@ def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig):
 def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig):
     """Pre-norm block: x + attn(ln1 x); then x + ffn(ln2 x).
 
-    ``named_scope`` tags every stage in HLO metadata and profiler traces —
-    the NVTX-range parity (reference transformer_annotated.py:35-98)."""
+    Returns ``(x, aux)`` — ``aux`` is the MoE load-balance loss for this
+    block (0.0 for the dense FFN). ``named_scope`` tags every stage in HLO
+    metadata and profiler traces — the NVTX-range parity (reference
+    transformer_annotated.py:35-98)."""
     with jax.named_scope("attn"):
         x = x + _mha(block_params["attn"], rmsnorm(block_params["ln1"], x), cos, sin, positions, cfg)
     with jax.named_scope("ffn"):
-        x = x + swiglu(block_params["ffn"], rmsnorm(block_params["ln2"], x), cfg.cdtype)
-    return x
+        h = rmsnorm(block_params["ln2"], x)
+        if cfg.num_experts > 0:
+            from cs336_systems_tpu.models.moe import moe_ffn
+
+            h, aux = moe_ffn(
+                block_params["ffn"], h, cfg.moe_top_k,
+                cfg.moe_capacity_factor, cfg.cdtype,
+            )
+        else:
+            h = swiglu(block_params["ffn"], h, cfg.cdtype)
+            aux = jnp.zeros((), jnp.float32)
+        x = x + h
+    return x, aux
 
 
-def transformer_lm(
+def transformer_lm_with_aux(
     params,
     token_ids: jax.Array,
     cfg: TransformerConfig,
     positions: jax.Array | None = None,
-) -> jax.Array:
-    """Forward pass: [B, S] int ids → [B, S, vocab] logits (compute dtype).
+) -> tuple[jax.Array, jax.Array]:
+    """Forward pass: [B, S] int ids → ([B, S, vocab] logits, aux scalar).
 
-    Layers run under ``lax.scan`` over the stacked block params
+    ``aux`` is the summed MoE load-balance loss over blocks (0.0 for dense
+    configs). Layers run under ``lax.scan`` over the stacked block params
     (``cfg.scan_layers``) or as an unrolled loop; with ``cfg.remat`` each
     block is wrapped in ``jax.checkpoint`` so the backward pass recomputes
     activations instead of storing S×L of them (HBM trade).
@@ -256,16 +286,18 @@ def transformer_lm(
     with jax.named_scope("embed"):
         x = embedding(params["token_embeddings"], token_ids, cfg.cdtype)
 
+    aux = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         # One compiled block body for any depth; backward stashes activations
         # into stacked [L, ...] buffers via dynamic-update-slice.
         def body(carry, bp):
-            return _block(bp, carry, cos, sin, positions, cfg), None
+            return _block(bp, carry, cos, sin, positions, cfg)
 
         if cfg.remat:
             body = jax.checkpoint(body, prevent_cse=False)
         with jax.named_scope("blocks"):
-            x, _ = jax.lax.scan(body, x, params["blocks"])
+            x, auxes = jax.lax.scan(body, x, params["blocks"])
+            aux = jnp.sum(auxes)
     else:
         # Unrolled: more HLO and compile time, but the backward reads each
         # layer's activations where they were produced — no stash copies.
@@ -279,12 +311,27 @@ def transformer_lm(
         with jax.named_scope("blocks"):
             for i in range(cfg.num_layers):
                 bp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
-                x = blk(bp, x, cos, sin, positions, cfg)
+                x, aux_i = blk(bp, x, cos, sin, positions, cfg)
+                aux = aux + aux_i
 
     with jax.named_scope("final_norm"):
         x = rmsnorm(params["ln_final"], x)
     with jax.named_scope("lm_head"):
-        return linear(params["lm_head"], x, cfg.cdtype)
+        return linear(params["lm_head"], x, cfg.cdtype), aux
+
+
+def transformer_lm(
+    params,
+    token_ids: jax.Array,
+    cfg: TransformerConfig,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """Forward pass: [B, S] int ids → [B, S, vocab] logits (compute dtype).
+
+    See ``transformer_lm_with_aux`` for the (logits, MoE aux loss) variant;
+    this drops the aux term (exactly zero for dense configs).
+    """
+    return transformer_lm_with_aux(params, token_ids, cfg, positions)[0]
 
 
 # ---------------------------------------------------------------------------

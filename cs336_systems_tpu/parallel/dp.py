@@ -169,6 +169,12 @@ def make_dp_train_step(
     all-reduce, naive_ddp.py:352-364), clipping runs on the *averaged*
     gradient so DP training is step-equivalent to large-batch single-device
     training.
+
+    MoE configs (num_experts > 0) train correctly — aux loss included — but
+    routing/capacity is computed per DP shard (T_local tokens), the standard
+    behavior of expert routers under data parallelism: which tokens drop at
+    capacity can differ from the single-device full-batch model, so the
+    step-equivalence guarantee above applies to dense configs.
     """
     from cs336_systems_tpu.train import lm_loss, make_update_fn
 

@@ -15,6 +15,7 @@ only DP):
 - ``tp``: tensor parallel — attention heads / FFN hidden sharded.
 - ``sp``: sequence/context parallel — sequence axis sharded (ring attention).
 - ``pp``: pipeline parallel — layer groups sharded.
+- ``ep``: expert parallel — MoE expert weights sharded.
 """
 
 from __future__ import annotations
@@ -25,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "tp", "sp", "pp")
+AXES = ("dp", "tp", "sp", "pp", "ep")
 
 
 def make_mesh(
@@ -56,6 +57,21 @@ def make_mesh(
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding that replicates an array across the whole mesh."""
     return NamedSharding(mesh, P())
+
+
+def shard_tree(tree, mesh: Mesh, specs):
+    """Place a pytree into the layout given by a matching PartitionSpec tree
+    (the one shard-params helper behind the tp/pp/ep layers)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def adamw_state_specs(param_specs_tree):
+    """AdamW moments shard exactly like their parameters; the step counter
+    is replicated. One place owns the optimizer-state layout so every
+    parallelism layer (tp/pp/ep) stays in sync with the AdamW pytree."""
+    return {"m": param_specs_tree, "v": param_specs_tree, "t": P()}
 
 
 def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:

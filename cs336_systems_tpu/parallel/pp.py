@@ -55,6 +55,13 @@ def validate_pp(cfg: TransformerConfig, mesh: Mesh, axis: str = "pp") -> None:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by pp={pp}"
         )
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MoE blocks under pipeline parallelism are not supported: "
+            "per-microbatch expert capacity changes the routing/dropping "
+            "semantics vs the full-batch model (shard experts over ep "
+            "instead — parallel/ep.py)"
+        )
 
 
 def param_specs(cfg: TransformerConfig, axis: str = "pp"):
@@ -79,15 +86,16 @@ def param_specs(cfg: TransformerConfig, axis: str = "pp"):
 
 
 def opt_state_specs(cfg: TransformerConfig, axis: str = "pp"):
-    ps = param_specs(cfg, axis)
-    return {"m": ps, "v": ps, "t": P()}
+    from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+    return adamw_state_specs(param_specs(cfg, axis))
 
 
 def shard_params_pp(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "pp"):
-    specs = param_specs(cfg, axis)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
-    )
+    """Place a (replicated/host) param pytree into its PP layout."""
+    from cs336_systems_tpu.parallel.mesh import shard_tree
+
+    return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
 def _stage_apply(blocks_local, h, cos, sin, positions, cfg: TransformerConfig):
@@ -97,7 +105,8 @@ def _stage_apply(blocks_local, h, cos, sin, positions, cfg: TransformerConfig):
     unpipelined model paths."""
 
     def body(carry, bp):
-        return _block(bp, carry, cos, sin, positions, cfg), None
+        h, _aux = _block(bp, carry, cos, sin, positions, cfg)
+        return h, None
 
     if cfg.remat:
         body = jax.checkpoint(body, prevent_cse=False)

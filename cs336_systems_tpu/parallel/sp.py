@@ -50,6 +50,13 @@ def make_sp_train_step(
     axes = tuple(a for a in (dp_axis, sp_axis) if a and a in mesh.shape)
     if sp_axis not in mesh.shape:
         raise ValueError(f"mesh {mesh.shape} has no {sp_axis!r} axis")
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MoE blocks under sequence parallelism are not supported: this "
+            "step uses the aux-free forward and per-sequence-shard routing "
+            "would change capacity semantics (shard experts over ep instead "
+            "— parallel/ep.py)"
+        )
     batch_spec = P(dp_axis if dp_axis in mesh.shape else None, sp_axis)
 
     from cs336_systems_tpu.train import make_update_fn

@@ -79,17 +79,16 @@ def param_specs(cfg: TransformerConfig, axis: str = "tp"):
 
 
 def opt_state_specs(cfg: TransformerConfig, axis: str = "tp"):
-    """AdamW moments shard exactly like their parameters."""
-    ps = param_specs(cfg, axis)
-    return {"m": ps, "v": ps, "t": P()}
+    from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+    return adamw_state_specs(param_specs(cfg, axis))
 
 
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "tp"):
     """Place a (replicated/host) param pytree into its TP layout."""
-    specs = param_specs(cfg, axis)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
-    )
+    from cs336_systems_tpu.parallel.mesh import shard_tree
+
+    return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
 def make_tp_train_step(

@@ -15,14 +15,20 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    transformer_lm_with_aux,
+)
 from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
 
 
 def lm_loss(params, x, y, cfg: TransformerConfig):
-    logits = transformer_lm(params, x, cfg)
-    return cross_entropy(logits, y)
+    logits, aux = transformer_lm_with_aux(params, x, cfg)
+    loss = cross_entropy(logits, y)
+    if cfg.num_experts > 0 and cfg.moe_aux_weight:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def make_update_fn(

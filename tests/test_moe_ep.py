@@ -1,0 +1,179 @@
+"""MoE layer and expert-parallelism tests.
+
+Oracles: the dense SwiGLU (a 1-expert MoE must reduce to it exactly) and
+the unsharded MoE step (ep sharding is a layout, not an approximation).
+Runs on the 8-virtual-device CPU mesh (conftest).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from common import trees_allclose
+from cs336_systems_tpu.models.layers import init_swiglu, swiglu
+from cs336_systems_tpu.models.moe import (
+    init_moe,
+    moe_capacity,
+    moe_ffn,
+    route_topk,
+)
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+    transformer_lm_with_aux,
+)
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.ep import (
+    make_ep_train_step,
+    shard_params_ep,
+    validate_ep,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.train import init_train_state, make_train_step
+
+MOE_CFG = TransformerConfig(
+    vocab_size=64, context_length=32, d_model=32,
+    num_layers=2, num_heads=4, d_ff=64,
+    num_experts=8, moe_top_k=2,
+)
+
+
+def test_single_expert_matches_dense_swiglu():
+    """E=1, k=1, ample capacity: MoE(x) == SwiGLU(x) exactly (router gives
+    the one expert weight 1.0)."""
+    key = jax.random.PRNGKey(0)
+    d, f = 16, 32
+    dense = init_swiglu(key, d, f)
+    moe = init_moe(jax.random.PRNGKey(1), d, f, 1)
+    # stack dense weights into the 1-expert slot
+    moe["experts"] = jax.tree_util.tree_map(lambda a: a[None], dense)
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 5, d))
+    out, aux = moe_ffn(moe, x, top_k=1, capacity_factor=2.0)
+    want = swiglu(dense, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)  # E=1: aux == 1
+
+
+def test_route_topk_respects_capacity_and_weights():
+    t, e, k = 12, 4, 2
+    gates = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (t, e)), axis=-1)
+    c = moe_capacity(t, e, k, 1.0)
+    dispatch, combine, aux = route_topk(gates, k, c)
+    # each (expert, slot) holds at most one token
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0 + 1e-6
+    # a token's combine weights sum to 1 when none of its experts overflowed
+    per_token = jnp.sum(combine, axis=(1, 2))
+    assert float(jnp.max(per_token)) <= 1.0 + 1e-6
+    # dispatched slots never exceed capacity
+    assert dispatch.shape == (t, e, c)
+    assert np.isfinite(float(aux))
+
+
+def test_route_topk_drops_overflow():
+    """All tokens prefer expert 0 with capacity 2: exactly 2 dispatched."""
+    t, e = 6, 2
+    gates = jnp.tile(jnp.asarray([[0.9, 0.1]]), (t, 1))
+    dispatch, combine, _ = route_topk(gates, 1, 2)
+    assert float(jnp.sum(dispatch[:, 0])) == 2.0
+    assert float(jnp.sum(dispatch[:, 1])) == 0.0
+
+
+def test_moe_lm_trains_and_aux_finite():
+    params, opt = init_train_state(jax.random.PRNGKey(0), MOE_CFG)
+    step = make_train_step(MOE_CFG, AdamWHparams(lr=1e-3), donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, MOE_CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    losses = []
+    for _ in range(5):
+        params, opt, loss = step(params, opt, x, y)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # it learns
+    logits, aux = transformer_lm_with_aux(params, x, MOE_CFG)
+    assert logits.shape == (4, 32, MOE_CFG.vocab_size)
+    assert np.isfinite(float(aux)) and float(aux) > 0.0
+
+
+def test_moe_all_experts_get_gradients():
+    params, _ = init_train_state(jax.random.PRNGKey(0), MOE_CFG)
+    from cs336_systems_tpu.train import lm_loss
+
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, MOE_CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    g = jax.grad(lm_loss)(params, x, y, MOE_CFG)
+    gw1 = g["blocks"]["ffn"]["experts"]["w1"]["weight"]  # [L, E, f, d]
+    per_expert = jnp.sum(jnp.abs(gw1), axis=(0, 2, 3))
+    # with top-2 of 8 experts over 128 tokens, every expert sees traffic
+    assert float(jnp.min(per_expert)) > 0.0
+    # router is differentiable
+    assert float(jnp.max(jnp.abs(g["blocks"]["ffn"]["router"]["weight"]))) > 0.0
+
+
+def test_ep_step_matches_unsharded():
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, MOE_CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+
+    params, opt = init_train_state(jax.random.PRNGKey(0), MOE_CFG)
+    ref = make_train_step(MOE_CFG, hp, donate=False)
+    p_ref, o_ref, l_ref = ref(params, opt, x, y)
+
+    p_ep = shard_params_ep(params, mesh, MOE_CFG)
+    o_ep = adamw_init(p_ep)
+    step = make_ep_train_step(MOE_CFG, hp, mesh, donate=False)
+    p_ep, o_ep, l_ep = step(p_ep, o_ep, x, y)
+
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_ep, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ep_validation():
+    mesh = make_mesh({"ep": 8})
+    dense = dataclasses.replace(MOE_CFG, num_experts=0)
+    with pytest.raises(ValueError, match="needs a MoE config"):
+        validate_ep(dense, mesh)
+    odd = dataclasses.replace(MOE_CFG, num_experts=6)
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_ep(odd, mesh)
+    with pytest.raises(ValueError, match="moe_top_k"):
+        dataclasses.replace(MOE_CFG, moe_top_k=9)
+
+
+def test_dp_moe_trains_with_aux():
+    """DP accepts MoE (per-shard routing, documented); loss finite, all
+    experts receive gradient traffic via the synced pytree."""
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+
+    mesh = make_mesh({"dp": 4})
+    params, opt = init_train_state(jax.random.PRNGKey(0), MOE_CFG)
+    step = make_dp_train_step(MOE_CFG, AdamWHparams(lr=1e-3), mesh, donate=False)
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, MOE_CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("dp"))
+    p2, o2, loss = step(params, opt, jax.device_put(x, sh), jax.device_put(y, sh))
+    assert np.isfinite(float(loss))
+    delta = jax.tree_util.tree_map(lambda a, b: jnp.max(jnp.abs(a - b)), params, p2)
+    assert float(delta["blocks"]["ffn"]["router"]["weight"]) > 0.0
+
+
+def test_sp_rejects_moe():
+    from cs336_systems_tpu.parallel.sp import make_sp_train_step
+
+    mesh = make_mesh({"sp": 4})
+    with pytest.raises(ValueError, match="MoE blocks under sequence"):
+        make_sp_train_step(MOE_CFG, AdamWHparams(lr=1e-3), mesh)
+
+
+def test_pp_rejects_moe():
+    from cs336_systems_tpu.parallel.pp import validate_pp
+
+    mesh = make_mesh({"pp": 2})
+    with pytest.raises(ValueError, match="MoE blocks under pipeline"):
+        validate_pp(MOE_CFG, mesh)
