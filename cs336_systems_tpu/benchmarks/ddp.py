@@ -1,0 +1,192 @@
+"""Data-parallel training benchmark: step time, communication share, and
+memory at phase boundaries for every DP variant (± ZeRO-1).
+
+Reference parity (cs336_systems/ddp_bucketed_overlapped_sharded.py:366-419
+and naive_ddp.py:372-438): argparse flags pick the variant; small-GPT
+hparams (d768 / ff3072 / 12L / 12H, vocab 10k, batch 128, ctx 128); timed
+step loop; per-phase memory accounting; printed table.
+
+TPU translation of "gradient-communication time": inside one jitted SPMD
+step there is no host-visible NCCL call to clock — XLA schedules and
+overlaps the psums. The honest decomposition is differential: time the full
+DP step, then an identical step with the gradient sync removed (variant
+"nosync" — mathematically wrong, measurement-only), on the same mesh. The
+difference is the *exposed* (non-overlapped) communication cost, which is
+what the reference's hook-timing ultimately measures too. Memory at phase
+boundaries (after init / after step; with and without ZeRO-1 sharding)
+comes from the live-buffer accounting in utils/profiling.
+
+Run: ``python -m cs336_systems_tpu.benchmarks.ddp --variants naive flat
+bucketed --sharded --steps 20`` (CPU: set the usual virtual-device flags).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import TransformerConfig, init_transformer_lm
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.mesh import make_mesh, shard_batch
+from cs336_systems_tpu.parallel.zero import make_zero1_train_step, zero1_init
+from cs336_systems_tpu.utils.profiling import live_buffer_bytes
+from cs336_systems_tpu.utils.timing import print_table, results_table, timed_total
+
+# Reference hparams (ddp_bucketed_overlapped_sharded.py:390-404): small-GPT.
+SMALL_GPT = dict(d_model=768, d_ff=3072, num_layers=12, num_heads=12)
+
+
+def _make_step(cfg, hp, mesh, variant: str, sharded: bool, bucket_mb: float):
+    """Build the jitted step for one benchmark row."""
+    if sharded:
+        return make_zero1_train_step(cfg, hp, mesh, donate=False), "zero1"
+    if variant == "nosync":
+        # measurement-only: the DP step with gradient communication removed
+        # (each replica applies its LOCAL gradient — wrong math, right cost
+        # model for the compute-only lower bound)
+        from jax.sharding import PartitionSpec as P
+
+        from cs336_systems_tpu.parallel.dp import local_value_and_grad
+        from cs336_systems_tpu.train import lm_loss, make_update_fn
+
+        def vag(params, x, y):
+            loss, grads = local_value_and_grad(
+                lambda p, xx, yy: lm_loss(p, xx, yy, cfg), "dp"
+            )(params, x, y)
+            return jax.lax.pmean(loss, "dp"), grads
+
+        local = make_update_fn(None, hp, 1.0, None, value_and_grad=vag)
+        step = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(step), "nosync"
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+
+    return (
+        make_dp_train_step(
+            cfg, hp, mesh, variant=variant, bucket_size_mb=bucket_mb, donate=False
+        ),
+        variant,
+    )
+
+
+def benchmark_variant(
+    cfg,
+    mesh,
+    variant: str,
+    sharded: bool = False,
+    batch_size: int = 128,
+    warmup: int = 2,
+    steps: int = 10,
+    bucket_mb: float = 1000.0,
+) -> dict:
+    hp = AdamWHparams(lr=3e-4)
+    mem0 = live_buffer_bytes()
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    if sharded:
+        opt = zero1_init(params, mesh)
+    else:
+        opt = adamw_init(params)
+    step, label = _make_step(cfg, hp, mesh, variant, sharded, bucket_mb)
+    mem_after_init = live_buffer_bytes()
+
+    x = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, cfg.context_length), 0, cfg.vocab_size
+    )
+    y = jnp.roll(x, -1, axis=-1)
+    x, y = shard_batch(mesh, x, y)
+
+    res, out = timed_total(
+        step, params, opt, x, y, warmup=warmup, iters=steps,
+        carry=lambda out, args: (out[0], out[1], args[2], args[3]),
+    )
+    loss = out[2]
+    dt = res.mean_ms / 1e3
+    mem_after_step = live_buffer_bytes()
+
+    return {
+        "variant": label,
+        "world": mesh.devices.size,
+        "step_ms": round(res.mean_ms, 2),
+        "tokens_per_s": round(batch_size * cfg.context_length / dt, 0),
+        "mem_init_mb": round((mem_after_init - mem0) / 2**20, 1),
+        "mem_step_mb": round((mem_after_step - mem0) / 2**20, 1),
+        "loss": round(float(loss), 4),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--variants", nargs="+",
+                   default=["naive", "flat", "bucketed"],
+                   choices=["naive", "flat", "bucketed", "nosync"])
+    p.add_argument("--sharded", action="store_true",
+                   help="also run the ZeRO-1 sharded-optimizer step")
+    p.add_argument("--no-comm-split", dest="comm_split", action="store_false",
+                   help="skip the nosync differential row")
+    p.add_argument("--dp", type=int, default=None,
+                   help="DP degree (default: all devices)")
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--ctx", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--layers", type=int, default=SMALL_GPT["num_layers"])
+    p.add_argument("--bucket-mb", type=float, default=1000.0)
+    p.add_argument("--latex", type=str, default=None)
+    args = p.parse_args(argv)
+
+    world = args.dp or len(jax.devices())
+    mesh = make_mesh({"dp": world})
+    cfg = TransformerConfig(
+        vocab_size=10_000,
+        context_length=args.ctx,
+        d_model=SMALL_GPT["d_model"],
+        d_ff=SMALL_GPT["d_ff"],
+        num_layers=args.layers,
+        num_heads=SMALL_GPT["num_heads"],
+        compute_dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+
+    rows = []
+    variants = list(args.variants)
+    if args.comm_split and "nosync" not in variants:
+        variants.append("nosync")
+    for v in variants:
+        rows.append(
+            benchmark_variant(
+                cfg, mesh, v, batch_size=args.batch, warmup=args.warmup,
+                steps=args.steps, bucket_mb=args.bucket_mb,
+            )
+        )
+    if args.sharded:
+        rows.append(
+            benchmark_variant(
+                cfg, mesh, "bucketed", sharded=True, batch_size=args.batch,
+                warmup=args.warmup, steps=args.steps,
+            )
+        )
+
+    nosync = next((r for r in rows if r["variant"] == "nosync"), None)
+    if nosync is not None:
+        for r in rows:
+            if r["variant"] != "nosync":
+                exposed = r["step_ms"] - nosync["step_ms"]
+                r["comm_ms_exposed"] = round(max(0.0, exposed), 2)
+                r["comm_pct"] = round(100 * max(0.0, exposed) / r["step_ms"], 1)
+
+    df = results_table(rows, latex_path=args.latex)
+    print_table(df)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,8 +6,10 @@ Parity with the reference ``get_batch`` (cs336-basics/cs336_basics/data.py:
 TPU-first: the crop gather is vectorised (one fancy-index instead of a
 Python loop of per-sample copies) and the result is shipped to device with
 a single ``jax.device_put`` — the analogue of the reference's pinned-memory
-async H2D. An optional native C++ sampler (``cs336_systems_tpu.data.native``)
-does the same gather off the GIL for large batches.
+async H2D. The native C++ sampler (``data.native_loader`` over
+``native/dataloader.cpp``: mmap corpus, xoshiro crops, threaded prefetch
+ring) does the same gather off the GIL and overlapped with device compute;
+``stream_batches`` prefers it and falls back to the NumPy path.
 """
 
 from __future__ import annotations
@@ -47,7 +49,50 @@ def get_batch(
     if not isinstance(rng, np.random.Generator):
         rng = np.random.default_rng(rng)
     x, y = sample_batch_np(np.asarray(dataset), batch_size, context_length, rng)
+    return _put(x, y, device, sharding)
+
+
+def _put(x, y, device, sharding):
     target = sharding if sharding is not None else device
     if target is not None:
         return jax.device_put(x, target), jax.device_put(y, target)
     return jnp.asarray(x), jnp.asarray(y)
+
+
+def stream_batches(
+    corpus_path,
+    batch_size: int,
+    context_length: int,
+    seed: int = 0,
+    dtype: str = "uint16",
+    device=None,
+    sharding=None,
+    use_native: bool | None = None,
+):
+    """Infinite iterator of device-placed (x, y) batches from a token FILE.
+
+    Prefers the native C++ prefetching loader (sampling overlaps with the
+    training step); ``use_native=None`` auto-falls back to a NumPy memmap
+    when the toolchain is unavailable. The two paths draw from different
+    RNGs, so fix ``use_native`` when bitwise batch reproducibility across
+    machines matters.
+    """
+    from cs336_systems_tpu.data.native_loader import (
+        NativeTokenLoader,
+        native_available,
+    )
+
+    native = native_available() if use_native is None else use_native
+    if native:
+        dl = NativeTokenLoader(corpus_path, dtype)
+        try:
+            for x, y in dl.batches(batch_size, context_length, seed):
+                yield _put(x, y, device, sharding)
+        finally:
+            dl.close()
+    else:
+        data = np.memmap(corpus_path, dtype=np.dtype(dtype), mode="r")
+        rng = np.random.default_rng(seed)
+        while True:
+            x, y = sample_batch_np(data, batch_size, context_length, rng)
+            yield _put(x, y, device, sharding)

@@ -1,0 +1,160 @@
+"""ctypes binding for the native (C++) data loader.
+
+The native component of the data path (see native/dataloader.cpp for the
+design): mmap'd token corpus, xoshiro random-crop sampling, threaded
+prefetch ring. This module compiles the shared library on first use (plain
+``g++ -O3 -shared -fPIC`` — no pybind11/bazel dependency), binds it with
+ctypes, and exposes:
+
+- ``NativeTokenLoader(path, dtype)`` — ``sample(batch, ctx, seed, step)``
+  (pure in its arguments) and ``batches(batch, ctx, seed)`` (prefetching
+  iterator yielding the same sequence).
+- ``native_available()`` — whether the library could be built/loaded;
+  callers fall back to the NumPy sampler in ``data.loader`` otherwise.
+
+Determinism contract (tested): the prefetch iterator yields exactly
+``sample(step=0), sample(step=1), ...``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_DTYPES = {"uint16": 0, "int32": 1, "uint32": 2, "int64": 3}
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "dataloader.cpp"
+_LIB = _SRC.with_suffix(".so")
+
+_lock = threading.Lock()
+_lib = None
+_load_error: str | None = None
+
+
+def _build_and_load():
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            if (not _LIB.exists()
+                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                cmd = [
+                    os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
+                    "-std=c++17", "-pthread", str(_SRC), "-o", str(_LIB),
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            lib = ctypes.CDLL(str(_LIB))
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _load_error = f"native loader unavailable: {detail}"
+            return None
+
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                ctypes.POINTER(ctypes.c_int64)]
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        lib.dl_len.restype = ctypes.c_int64
+        lib.dl_len.argtypes = [ctypes.c_void_p]
+        lib.dl_token.restype = ctypes.c_int64
+        lib.dl_token.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.dl_sample.restype = ctypes.c_int32
+        lib.dl_sample.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_uint64,
+                                  ctypes.c_int64, i32p, i32p]
+        lib.dl_prefetch_start.restype = ctypes.c_int32
+        lib.dl_prefetch_start.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_int64, ctypes.c_uint64,
+                                          ctypes.c_int32]
+        lib.dl_next.restype = ctypes.c_int32
+        lib.dl_next.argtypes = [ctypes.c_void_p, i32p, i32p]
+        lib.dl_prefetch_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def native_load_error() -> str | None:
+    _build_and_load()
+    return _load_error
+
+
+class NativeTokenLoader:
+    """Random-crop LM batch sampler over a memmapped token file."""
+
+    def __init__(self, path: str | os.PathLike, dtype: str = "uint16"):
+        if dtype not in _DTYPES:
+            raise ValueError(f"dtype {dtype!r} not in {sorted(_DTYPES)}")
+        lib = _build_and_load()
+        if lib is None:
+            raise RuntimeError(_load_error)
+        self._lib = lib
+        n = ctypes.c_int64()
+        self._h = lib.dl_open(str(path).encode(), _DTYPES[dtype],
+                              ctypes.byref(n))
+        if not self._h:
+            raise OSError(f"dl_open failed for {path!r} (dtype {dtype})")
+        self.num_tokens = int(n.value)
+        self._prefetching = False
+
+    def __len__(self) -> int:
+        return self.num_tokens
+
+    def token(self, i: int) -> int:
+        return int(self._lib.dl_token(self._h, i))
+
+    def sample(self, batch: int, ctx: int, seed: int, step: int):
+        """-> (x, y) int32 [batch, ctx]; pure in (batch, ctx, seed, step)."""
+        x = np.empty((batch, ctx), np.int32)
+        y = np.empty((batch, ctx), np.int32)
+        rc = self._lib.dl_sample(self._h, batch, ctx, seed, step, x, y)
+        if rc != 0:
+            raise ValueError(
+                f"dl_sample failed (batch={batch}, ctx={ctx}, "
+                f"corpus={self.num_tokens} tokens)"
+            )
+        return x, y
+
+    def batches(self, batch: int, ctx: int, seed: int, slots: int = 4):
+        """Prefetching iterator: yields the ``sample(step=0,1,2,...)``
+        sequence with sampling overlapped against the consumer."""
+        rc = self._lib.dl_prefetch_start(self._h, batch, ctx, seed, slots)
+        if rc != 0:
+            raise RuntimeError("prefetch already running or bad args")
+        self._prefetching = True
+        try:
+            while True:
+                x = np.empty((batch, ctx), np.int32)
+                y = np.empty((batch, ctx), np.int32)
+                if self._lib.dl_next(self._h, x, y) != 0:
+                    return
+                yield x, y
+        finally:
+            self._lib.dl_prefetch_stop(self._h)
+            self._prefetching = False
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

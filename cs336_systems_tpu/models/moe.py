@@ -29,7 +29,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear
+from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear, swiglu
 
 
 def init_moe(key, d_model: int, d_ff: int, num_experts: int, dtype=jnp.float32):
@@ -91,8 +91,6 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
     Three einsums around a vmapped expert SwiGLU:
     dispatch ([T,E,C] × [T,D] → [E,C,D]) → experts → combine back.
     """
-    from cs336_systems_tpu.models.layers import swiglu
-
     lead = x.shape[:-1]
     d = x.shape[-1]
     xt = x.reshape(-1, d)  # [T, D]

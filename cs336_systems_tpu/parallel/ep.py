@@ -21,7 +21,7 @@ import functools
 from typing import Callable
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig
 from cs336_systems_tpu.optim.adamw import AdamWHparams
@@ -104,10 +104,9 @@ def make_ep_train_step(
     pspecs = param_specs(cfg, ep_axis)
     ospecs = opt_state_specs(cfg, ep_axis)
     bspec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
-    sh = lambda spec: jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), spec,
-        is_leaf=lambda s: isinstance(s, P),
-    )
+    from cs336_systems_tpu.parallel.mesh import named_sharding_tree
+
+    sh = functools.partial(named_sharding_tree, mesh)
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule

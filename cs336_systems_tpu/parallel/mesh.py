@@ -67,6 +67,14 @@ def shard_tree(tree, mesh: Mesh, specs):
     )
 
 
+def named_sharding_tree(mesh: Mesh, specs):
+    """PartitionSpec tree → NamedSharding tree (for jit in/out_shardings)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
 def adamw_state_specs(param_specs_tree):
     """AdamW moments shard exactly like their parameters; the step counter
     is replicated. One place owns the optimizer-state layout so every

@@ -30,8 +30,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig
 from cs336_systems_tpu.optim.adamw import AdamWHparams
@@ -117,10 +116,9 @@ def make_tp_train_step(
     pspecs = param_specs(cfg, tp_axis)
     ospecs = opt_state_specs(cfg, tp_axis)
     bspec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
-    sh = lambda spec: jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), spec,
-        is_leaf=lambda s: isinstance(s, P),
-    )
+    from cs336_systems_tpu.parallel.mesh import named_sharding_tree
+
+    sh = functools.partial(named_sharding_tree, mesh)
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule

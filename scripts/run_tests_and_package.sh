@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Test-and-package harness — parity with the reference's
+# test_and_make_submission.sh:1-32 (runs the full pytest suite with a JUnit
+# XML report, then zips the tree minus caches/artifacts).
+#
+# Usage: scripts/run_tests_and_package.sh [out.zip]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-cs336_systems_tpu_submission.zip}"
+
+# Hermetic CPU run with the 8-device virtual mesh (same env the test
+# conftest selects; the env vars also cover any site TPU plugin).
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m pytest -v tests/ --junitxml=test_results.xml || true
+
+zip -r "$OUT" . \
+    -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
+    -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" \
+    >/dev/null
+echo "wrote $OUT"
+unzip -l "$OUT" | tail -1

@@ -143,6 +143,20 @@ def test_trace_writes_profile(tmp_path):
     assert found, f"no trace artifacts under {logdir}"
 
 
+def test_ddp_benchmark_cli_smoke(capsys):
+    """The DDP benchmark driver runs end-to-end on the CPU mesh and prints
+    every requested variant row plus the differential comm split."""
+    from cs336_systems_tpu.benchmarks.ddp import main
+
+    main([
+        "--variants", "naive", "--sharded", "--batch", "8", "--ctx", "32",
+        "--steps", "1", "--warmup", "1", "--layers", "2", "--dp", "4",
+    ])
+    out = capsys.readouterr().out
+    for token in ("naive", "nosync", "zero1", "step_ms", "comm_pct"):
+        assert token in out, f"missing {token!r} in DDP benchmark output"
+
+
 def test_named_scopes_in_hlo():
     """The model's named_scope annotations must land in HLO metadata —
     that is the NVTX-parity contract (reference transformer_annotated.py)."""

@@ -1,0 +1,119 @@
+"""Native (C++) data loader tests.
+
+Contract under test (data/native_loader.py + native/dataloader.cpp):
+correct crop semantics (y is x shifted by one in the corpus), determinism
+in (seed, step), seed independence, prefetch-equals-sample sequence, and
+dtype handling. Skips if no C++ toolchain is available.
+"""
+
+import numpy as np
+import pytest
+
+from cs336_systems_tpu.data.native_loader import (
+    NativeTokenLoader,
+    native_available,
+    native_load_error,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason=f"native loader: {native_load_error()}"
+)
+
+
+@pytest.fixture(scope="module")
+def corpus_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("corpus") / "tokens.bin"
+    tokens = np.arange(50_000, dtype=np.uint16) % 1000
+    tokens.tofile(path)
+    return path, tokens
+
+
+def test_open_and_len(corpus_file):
+    path, tokens = corpus_file
+    with NativeTokenLoader(path) as dl:
+        assert len(dl) == tokens.size
+        assert dl.token(0) == int(tokens[0])
+        assert dl.token(1234) == int(tokens[1234])
+
+
+def test_crop_semantics_and_ranges(corpus_file):
+    path, tokens = corpus_file
+    with NativeTokenLoader(path) as dl:
+        x, y = dl.sample(batch=16, ctx=64, seed=7, step=0)
+        assert x.shape == y.shape == (16, 64) and x.dtype == np.int32
+        # every row must be a contiguous corpus crop with y = next tokens
+        for b in range(16):
+            # recover the start from the corpus pattern (i % 1000 with a
+            # strictly increasing underlying index makes rows unique by
+            # locating the crop via exact match)
+            matches = np.flatnonzero(
+                np.all(np.lib.stride_tricks.sliding_window_view(
+                    tokens, 64) == x[b].astype(np.uint16), axis=1)
+            )
+            assert matches.size >= 1
+            s = int(matches[0])
+            np.testing.assert_array_equal(
+                y[b], tokens[s + 1 : s + 65].astype(np.int32)
+            )
+
+
+def test_determinism_and_seed_independence(corpus_file):
+    path, _ = corpus_file
+    with NativeTokenLoader(path) as dl:
+        a = dl.sample(8, 32, seed=1, step=5)
+        b = dl.sample(8, 32, seed=1, step=5)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+        c = dl.sample(8, 32, seed=1, step=6)
+        d = dl.sample(8, 32, seed=2, step=5)
+        assert not np.array_equal(a[0], c[0])
+        assert not np.array_equal(a[0], d[0])
+
+
+def test_prefetch_matches_sample_sequence(corpus_file):
+    path, _ = corpus_file
+    with NativeTokenLoader(path) as dl:
+        want = [dl.sample(4, 16, seed=3, step=s) for s in range(6)]
+        it = dl.batches(4, 16, seed=3, slots=3)
+        got = [next(it) for _ in range(6)]
+        it.close()
+        for (wx, wy), (gx, gy) in zip(want, got):
+            np.testing.assert_array_equal(wx, gx)
+            np.testing.assert_array_equal(wy, gy)
+        # prefetch can be restarted after close
+        it2 = dl.batches(4, 16, seed=3, slots=2)
+        gx2, _ = next(it2)
+        it2.close()
+        np.testing.assert_array_equal(gx2, want[0][0])
+
+
+def test_int32_corpus(tmp_path):
+    path = tmp_path / "tok32.bin"
+    tokens = (np.arange(10_000, dtype=np.int32) * 7) % 50_021
+    tokens.tofile(path)
+    with NativeTokenLoader(path, dtype="int32") as dl:
+        assert len(dl) == tokens.size
+        x, y = dl.sample(4, 128, seed=0, step=0)
+        assert int(x.max()) < 50_021 and int(x.min()) >= 0
+
+
+def test_stream_batches_both_paths(corpus_file):
+    """The high-level iterator works over the native and NumPy backends and
+    yields self-consistent (x, y) crops."""
+    from cs336_systems_tpu.data.loader import stream_batches
+
+    path, _ = corpus_file
+    for use_native in (True, False):
+        it = stream_batches(path, 4, 32, seed=5, use_native=use_native)
+        x, y = next(it)
+        it.close()
+        assert x.shape == (4, 32)
+        np.testing.assert_array_equal(np.asarray(y)[:, :-1], np.asarray(x)[:, 1:])
+
+
+def test_too_short_corpus_errors(tmp_path):
+    path = tmp_path / "tiny.bin"
+    np.arange(8, dtype=np.uint16).tofile(path)
+    with NativeTokenLoader(path) as dl:
+        with pytest.raises(ValueError, match="dl_sample failed"):
+            dl.sample(2, 64, seed=0, step=0)
