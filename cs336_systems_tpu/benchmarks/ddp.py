@@ -141,6 +141,10 @@ def main(argv=None) -> None:
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--layers", type=int, default=SMALL_GPT["num_layers"])
+    p.add_argument("--d-model", type=int, default=SMALL_GPT["d_model"])
+    p.add_argument("--d-ff", type=int, default=SMALL_GPT["d_ff"])
+    p.add_argument("--heads", type=int, default=SMALL_GPT["num_heads"])
+    p.add_argument("--vocab", type=int, default=10_000)
     p.add_argument("--bucket-mb", type=float, default=1000.0)
     p.add_argument("--latex", type=str, default=None)
     args = p.parse_args(argv)
@@ -148,12 +152,12 @@ def main(argv=None) -> None:
     world = args.dp or len(jax.devices())
     mesh = make_mesh({"dp": world})
     cfg = TransformerConfig(
-        vocab_size=10_000,
+        vocab_size=args.vocab,
         context_length=args.ctx,
-        d_model=SMALL_GPT["d_model"],
-        d_ff=SMALL_GPT["d_ff"],
+        d_model=args.d_model,
+        d_ff=args.d_ff,
         num_layers=args.layers,
-        num_heads=SMALL_GPT["num_heads"],
+        num_heads=args.heads,
         compute_dtype="bfloat16" if jax.default_backend() == "tpu" else "float32",
     )
 

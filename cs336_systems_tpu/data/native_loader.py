@@ -44,11 +44,30 @@ def _build_and_load():
         try:
             if (not _LIB.exists()
                     or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
-                cmd = [
-                    os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
-                    "-std=c++17", "-pthread", str(_SRC), "-o", str(_LIB),
-                ]
-                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                # Concurrency-safe build: an exclusive file lock serialises
+                # concurrent builders (pytest-xdist, multi-process hosts),
+                # and the compile goes to a temp path that is atomically
+                # renamed — a reader can never CDLL a half-written .so.
+                import fcntl
+
+                lock_path = _LIB.with_suffix(".lock")
+                with open(lock_path, "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    try:
+                        if (not _LIB.exists()
+                                or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                            tmp = _LIB.with_suffix(f".tmp{os.getpid()}.so")
+                            cmd = [
+                                os.environ.get("CXX", "g++"), "-O3",
+                                "-shared", "-fPIC", "-std=c++17", "-pthread",
+                                str(_SRC), "-o", str(tmp),
+                            ]
+                            subprocess.run(
+                                cmd, check=True, capture_output=True, text=True
+                            )
+                            os.rename(tmp, _LIB)
+                    finally:
+                        fcntl.flock(lock, fcntl.LOCK_UN)
             lib = ctypes.CDLL(str(_LIB))
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)

@@ -32,6 +32,16 @@ def test_make_mesh_too_many_devices():
         make_mesh({"dp": 1024})
 
 
+def test_init_distributed_single_process_noop(monkeypatch):
+    """Without cluster env vars or explicit args, init_distributed must not
+    try to rendezvous — it returns the current process count."""
+    from cs336_systems_tpu.parallel import mesh as mesh_mod
+
+    for v in mesh_mod._CLUSTER_ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+    assert mesh_mod.init_distributed() == jax.process_count() == 1
+
+
 def test_shard_batch_layout():
     mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
     x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)

@@ -93,6 +93,33 @@ def test_cross_entropy_matches_manual():
     assert math.isclose(got, float(expected), rel_tol=1e-5)
 
 
+def test_cross_entropy_custom_vjp_matches_autodiff():
+    """cross_entropy's fused backward (softmax − onehot scaled by the
+    cotangent) must equal autodiff through log_softmax, in fp32 and bf16,
+    including non-unit cotangents."""
+
+    def ce_ref(logits, targets):
+        nls = -log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.mean(
+            jnp.take_along_axis(nls, targets[..., None].astype(jnp.int32), -1)
+        )
+
+    targets = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 101)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 101), dtype) * 3
+        np.testing.assert_allclose(
+            float(cross_entropy(logits, targets)), float(ce_ref(logits, targets)),
+            rtol=1e-6,
+        )
+        for scale in (1.0, 3.5):
+            g1 = jax.grad(lambda x: scale * cross_entropy(x, targets))(logits)
+            g2 = jax.grad(lambda x: scale * ce_ref(x, targets))(logits)
+            np.testing.assert_allclose(
+                np.asarray(g1, np.float32), np.asarray(g2, np.float32),
+                rtol=1e-2, atol=1e-6,
+            )
+
+
 def test_gradient_clipping():
     grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
     norm = float(global_grad_norm(grads))
