@@ -39,15 +39,16 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     ctx = 512
-    batch = 16 if on_tpu else 2
-    # Measured on v5e (see PROGRESS notes): the un-tiled fused-XLA attention
-    # forward with LSE-only residuals beats the Pallas grid at S=512, and the
-    # unrolled layer loop beats lax.scan (no activation-stash copies).
+    batch = 32 if on_tpu else 2
+    # Measured on v5e (BASELINE.md): the Pallas kernels (512-tile forward +
+    # fused single-pass backward, S×S only ever in VMEM) beat the fused-XLA
+    # attention end to end, and the unrolled layer loop beats lax.scan (no
+    # activation-stash copies). Batch 32 is the measured throughput peak.
     cfg = config_for_size(
         "small",
         context_length=ctx,
         compute_dtype="bfloat16",
-        attn_impl="flash_xla" if on_tpu else "xla",
+        attn_impl="flash" if on_tpu else "xla",
         scan_layers=not on_tpu,
     )
 
