@@ -23,7 +23,12 @@ import jax.numpy as jnp
 from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
 from cs336_systems_tpu.ops.flash_attention import flash_attention
 from cs336_systems_tpu.utils.profiling import peak_bytes
-from cs336_systems_tpu.utils.timing import error_cell, print_table, results_table, timed
+from cs336_systems_tpu.utils.timing import (
+    error_cell,
+    print_table,
+    results_table,
+    timed_total,
+)
 
 SEQ_LENS = (128, 256, 1024, 4096, 16384, 65536)
 HEAD_DIMS = (16, 32, 64, 128)
@@ -81,15 +86,30 @@ def benchmark_attention_cell(
         after = peak_bytes()
         return round(after / 2**20, 1) if after > peak_before else None
 
+    # timed_total (one fence around the loop) rather than timed (per-iter
+    # fences): on remote-dispatch runtimes a per-iteration fence adds many
+    # ms of host latency to every cell, swamping sub-ms kernels. Phases fail
+    # independently — at 65k the flash FORWARD fits (O(S) memory) while any
+    # backward that materializes S×S OOMs; that asymmetry is the result.
     p0 = peak_bytes()
-    t_fwd, _ = timed(fwd, q, k, v, warmup=warmup, iters=iters)
-    row["forward_ms"] = round(t_fwd.mean_ms, 3)
-    row["fwd_peak_mb"] = cell_peak(p0)
+    try:
+        t_fwd, _ = timed_total(fwd, q, k, v, warmup=warmup, iters=iters)
+        row["forward_ms"] = round(t_fwd.mean_ms, 3)
+        row["fwd_peak_mb"] = cell_peak(p0)
+    except Exception as e:  # OOM/compile failure recorded as a null cell
+        t_fwd = None
+        row["forward_ms"] = None
+        row["fwd_error"] = error_cell(e)
     p1 = peak_bytes()
-    t_fb, _ = timed(fwd_bwd, q, k, v, warmup=warmup, iters=iters)
-    row["fwd_bwd_ms"] = round(t_fb.mean_ms, 3)
-    row["backward_ms"] = round(max(t_fb.mean_ms - t_fwd.mean_ms, 0.0), 3)
-    row["fwd_bwd_peak_mb"] = cell_peak(p1)
+    try:
+        t_fb, _ = timed_total(fwd_bwd, q, k, v, warmup=warmup, iters=iters)
+        row["fwd_bwd_ms"] = round(t_fb.mean_ms, 3)
+        if t_fwd is not None:
+            row["backward_ms"] = round(max(t_fb.mean_ms - t_fwd.mean_ms, 0.0), 3)
+        row["fwd_bwd_peak_mb"] = cell_peak(p1)
+    except Exception as e:
+        row["fwd_bwd_ms"] = None
+        row["bwd_error"] = error_cell(e)
     return row
 
 

@@ -14,15 +14,21 @@ Capability parity with the reference's three-part FlashAttention surface
   k steps, so K/V stream through VMEM and sequence length is bounded by HBM,
   not VMEM. Tiles are MXU-aligned (128) instead of the reference's 16.
 - ``backward_pass_recomp`` under ``torch.compile`` (flash_attention.py:270-289)
-  → TWO recompute backwards behind ``jax.custom_vjp``, both using the saved
+  → THREE recompute backwards behind ``jax.custom_vjp``, all using the saved
   logsumexp (P = exp(S − L), D = rowsum(O ∘ dO), dV = PᵀdO,
-  dS = P ∘ (dP − D), dQ = dS·K/√d, dK = dSᵀ·Q/√d):
-  (a) ``_flash_bwd_pallas`` — a fused single-pass Pallas kernel, grid over
-  (batch·head), whole sequence per step, every S×S intermediate living in
-  VMEM only (used on TPU for pallas/auto impls with lane-aligned
-  S ≤ ``_BWD_PALLAS_MAX_S``); (b) ``_flash_bwd_recompute`` — the XLA-jitted
-  fallback, which like the reference materializes the full [B, n_q, n_k]
-  matrix in HBM but handles any shape/backend.
+  dS = P ∘ (dP − D), dQ = dS·K/√d, dK = dSᵀ·Q/√d; shared recompute core
+  ``_recompute_p_ds``), dispatched in ``_flash_bwd_rule``:
+  (a) ``_flash_bwd_pallas`` — fused single-pass Pallas kernel, grid over
+  (batch·head), whole sequence per step, every S×S intermediate in VMEM
+  only (TPU, pallas/auto impls, lane-aligned S up to the dtype-aware
+  ``_BWD_PALLAS_MAX_S_BF16``/``_F32`` VMEM bounds);
+  (b) ``_flash_bwd_pallas_tiled`` — the FlashAttention-2 two-pass tiled
+  schedule (dK/dV pass over k-tiles, dQ pass over q-tiles), O(S) memory at
+  any length — this is what trains attention at S = 65,536 where any S×S
+  materialization OOMs;
+  (c) ``_flash_bwd_recompute`` — the XLA-jitted fallback, which like the
+  reference materializes the full [B, n_q, n_k] matrix in HBM but handles
+  any shape/backend.
 
 Contracts shared with the reference (tests/test_attention.py):
 - forward saves exactly (Q, K, V, O, L) where L = m + log l is the per-row
@@ -274,42 +280,50 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
 # q/k/v/o/do/dq/dk/dv. Live S×S tensors: s/p (fp32), dp (fp32), pb/ds
 # (input dtype) — ~14 MB at S=1024 bf16, ~24 MB at S=1024 fp32; the fp32
 # case exceeds v5e VMEM (Mosaic compile failure, verified on chip), so the
-# bound is dtype-aware. Beyond it the XLA recompute path takes over (it
-# materializes S×S in HBM but tiles arbitrarily).
+# bound is dtype-aware. Both bounds verified on chip up to d_head=128 (the
+# S×S terms dominate; d only adds the [S, d] operand blocks). Beyond the
+# bound the tiled two-pass kernels take over (O(tile²) VMEM, any length).
 _BWD_PALLAS_MAX_S_BF16 = 1024
 _BWD_PALLAS_MAX_S_F32 = 512
+
+
+def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
+                    q_off, k_off):
+    """Shared recompute core of every Pallas backward kernel: scaled QKᵀ,
+    causal mask at global offsets, P = exp(S − L), dP = dO·Vᵀ,
+    dS = P ∘ (dP − D) · scale. Returns (p fp32, ds in q.dtype)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        n_q, n_k = s.shape
+        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
+        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jnp.exp(s - lse)  # fp32; masked entries exp(-inf - lse) = 0
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    return p, ds
 
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
                       dq_ref, dk_ref, dv_ref, *, scale: float, causal: bool):
     q = q_ref[0]
     k = k_ref[0]
-    v = v_ref[0]
     o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [S, 1] column (host passes lse[..., None])
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        n_q, n_k = s.shape
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jnp.exp(s - lse)  # [S, S] fp32; masked entries exp(-inf - lse) = 0
-
     delta = jnp.sum(o * do, axis=-1, keepdims=True)  # D: [S, 1]
-    pb = p.astype(v_ref.dtype)
+
+    p, ds = _recompute_p_ds(q, k, v_ref[0], do, lse, delta,
+                            scale=scale, causal=causal, q_off=0, k_off=0)
     dv = jax.lax.dot_general(
-        pb, do.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
+        p.astype(v_ref.dtype), do.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    dp = jax.lax.dot_general(
-        do.astype(v_ref.dtype), v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
     dq = jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -352,6 +366,147 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v, o, lse[..., None], do)
+    return dq, dk, dv
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, causal: bool, bq: int, bk: int,
+                    n_q_tiles: int):
+    """Pass 1 of the tiled backward: grid (bh, k-tile, q-tile), q innermost.
+    VMEM scratch accumulates dK/dV for the current k-tile across q-tiles."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q-tiles strictly left of the k-tile see none of its keys
+    needed = (qi * bq + bq - 1 >= kj * bk) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p, ds = _recompute_p_ds(
+            q, k_ref[0], v_ref[0], do, lse_ref[0], delta_ref[0],
+            scale=scale, causal=causal, q_off=qi * bq, k_off=kj * bk,
+        )
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), do.astype(v_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q_tiles - 1)
+    def _epilogue():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc,
+                   *, scale: float, causal: bool, bq: int, bk: int,
+                   n_k_tiles: int):
+    """Pass 2: grid (bh, q-tile, k-tile), k innermost; accumulates dQ."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (kj * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        do = do_ref[0].astype(jnp.float32)
+        _, ds = _recompute_p_ds(
+            q_ref[0], k_ref[0], v_ref[0], do, lse_ref[0], delta_ref[0],
+            scale=scale, causal=causal, q_off=qi * bq, k_off=kj * bk,
+        )
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == n_k_tiles - 1)
+    def _epilogue():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
+                            q_tile: int = 512, k_tile: int = 512,
+                            interpret: bool | None = None):
+    """Tiled two-pass backward for long sequences: O(S) memory — no S×S
+    tensor ever leaves VMEM. Recomputes P per tile from the saved
+    logsumexp (the FlashAttention-2 backward schedule: a dK/dV pass over
+    k-tiles with q innermost, then a dQ pass over q-tiles with k innermost).
+    Requires tile-aligned S (the custom-vjp gate guarantees it)."""
+    b, n_q, d = q.shape
+    n_k = k.shape[1]
+    bq = _pick_tile(n_q, q_tile)
+    bk = _pick_tile(n_k, k_tile)
+    tq, tk = n_q // bq, n_k // bk
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # delta = rowsum(o * do): cheap [B, S] precompute outside the kernels
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    lse_c = lse[..., None]      # [B, S, 1] column blocks
+    delta_c = delta[..., None]
+
+    common = dict(interpret=interpret)
+    scale = 1.0 / math.sqrt(d)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_q_tiles=tq),
+        grid=(b, tk, tq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bi, kj, qi: (bi, qi, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda bi, kj, qi: (bi, qi, 0)),   # do
+            pl.BlockSpec((1, bq, 1), lambda bi, kj, qi: (bi, qi, 0)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda bi, kj, qi: (bi, qi, 0)),   # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        **common,
+    )(q, k, v, do, lse_c, delta_c)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_k_tiles=tk),
+        grid=(b, tq, tk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda bi, qi, kj: (bi, kj, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda bi, qi, kj: (bi, kj, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # do
+            pl.BlockSpec((1, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        **common,
+    )(q, k, v, do, lse_c, delta_c)
     return dq, dk, dv
 
 
@@ -452,13 +607,31 @@ def _eligible_for_pallas_bwd(q, k, impl) -> bool:
     )
 
 
+def _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile) -> bool:
+    """The two-pass tiled backward needs tile-divisible (unpadded) lengths;
+    it has no VMEM sequence bound — memory is O(tile²). The user's forward
+    tile sizes are honored so lengths divisible by a smaller chosen tile
+    (but not by 512) still take the Pallas path."""
+    if impl not in ("pallas", "auto") or jax.default_backend() != "tpu":
+        return False
+    n_q, n_k = q.shape[1], k.shape[1]
+    bq, bk = _pick_tile(n_q, q_tile), _pick_tile(n_k, k_tile)
+    return n_q % bq == 0 and n_k % bk == 0
+
+
 def _flash_bwd_rule(causal, impl, q_tile, k_tile, res, cotangents):
     q, k, v, o, lse = res
     # LSE is a saved softmax statistic, not a differentiable output (parity:
     # the reference backward receives only dO); its cotangent is discarded.
     do, _ = cotangents
     if _eligible_for_pallas_bwd(q, k, impl):
+        # single fused kernel: whole sequence per grid step, least recompute
         return _flash_bwd_pallas(q, k, v, o, lse, do, causal)
+    if _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile):
+        # two-pass tiled kernels: any length, O(S) memory
+        return _flash_bwd_pallas_tiled(
+            q, k, v, o, lse, do, causal, q_tile=q_tile, k_tile=k_tile
+        )
     return _flash_bwd_recompute(q, k, v, o, lse, do, causal)
 
 
