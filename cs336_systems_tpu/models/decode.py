@@ -1,0 +1,220 @@
+"""KV-cache incremental decoding — the fast inference path.
+
+Capability beyond the reference: ``BasicsTransformerLM.generate``
+(model.py:255-310) runs a FULL forward per emitted token (O(S²·L) per
+token); here a prefill pass populates per-layer K/V caches and each new
+token costs one cached attention row (O(S·L)). The reference's sampling
+semantics (temperature scale → optional top-k threshold → categorical
+draw, EOS stop, context-window bound) are preserved exactly.
+
+TPU-first design:
+
+- The cache is a pytree of stacked [L, B, H, S_max, Dh] arrays riding the
+  same leading layer axis as the block params, so one ``lax.scan`` body
+  serves every layer and the whole decode LOOP runs inside a single jit
+  (``lax.scan`` over steps, PRNG key threaded through the carry) — one
+  dispatch per generation, not per token, which matters when host→device
+  dispatch costs milliseconds.
+- Static shapes throughout: the cache is allocated at ``S_max`` once and
+  masked by the current length (``iota <= pos``) — no dynamic shapes, no
+  recompilation per step.
+- EOS: a scan cannot early-exit, so generation runs to ``max_new_tokens``
+  and the host truncates at the first EOS — same output, fixed cost.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.layers import apply_rope, embedding, linear, rmsnorm, rope_cache, swiglu
+from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
+    """Zeroed cache pytree: {"k", "v"} of [L, B, H, S_max, Dh] (compute
+    dtype) plus the fill length."""
+    s = max_len or cfg.context_length
+    shape = (cfg.num_layers, batch, cfg.num_heads, s, cfg.d_head)
+    return {
+        "k": jnp.zeros(shape, cfg.cdtype),
+        "v": jnp.zeros(shape, cfg.cdtype),
+    }
+
+
+def _cached_attention(q, k_cache, v_cache, pos):
+    """q: [B,H,1,Dh]; caches [B,H,S,Dh]; attend to positions <= pos.
+
+    Delegates to the shared masked-softmax op (ops/attention.py) — the mask
+    [1, S] selects the filled cache prefix."""
+    from cs336_systems_tpu.ops.attention import attention_with_lse
+
+    s = k_cache.shape[-2]
+    mask = (jnp.arange(s) <= pos)[None, :]
+    return attention_with_lse(q, k_cache, v_cache, mask)[0]
+
+
+def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
+    """One block on a single-token hidden state; returns (x, kc, vc)."""
+    b = x.shape[0]
+    h, dh = cfg.num_heads, cfg.d_head
+    hsplit = lambda t: t.reshape(b, 1, h, dh).transpose(0, 2, 1, 3)
+
+    hx = rmsnorm(bp["ln1"], x)
+    q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
+    k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
+    v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
+    positions = pos[None]  # [1]
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+
+    kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+    attn = _cached_attention(q, kc, vc, pos)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
+    x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+    x = x + swiglu(bp["ffn"], rmsnorm(bp["ln2"], x), cfg.cdtype)
+    return x, kc, vc
+
+
+def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig):
+    """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
+    → (logits [B, vocab] fp32, updated cache)."""
+    if cfg.num_experts > 0:
+        raise ValueError("KV-cache decode does not support MoE blocks yet")
+    pos = jnp.asarray(pos, jnp.int32)
+    cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
+    x = embedding(params["token_embeddings"], token_ids[:, None], cfg.cdtype)
+
+    def body(carry, layer):
+        x = carry
+        bp, kc, vc = layer
+        x, kc, vc = _decode_block(bp, x, kc, vc, cos, sin, pos, cfg)
+        return x, (kc, vc)
+
+    x, (kcs, vcs) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rmsnorm(params["ln_final"], x)
+    logits = linear(params["lm_head"], x, cfg.cdtype)[:, 0]
+    return logits.astype(jnp.float32), {"k": kcs, "v": vcs}
+
+
+def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None):
+    """Fill the cache with ONE batched forward over the whole prompt (full
+    MXU tiles, causal attention), capturing each layer's post-RoPE K/V into
+    the cache — identical values to stepwise decoding, since projections
+    are position-independent.
+
+    prompt_ids: [B, P] (P <= context window). Returns (last-token logits
+    [B, vocab] fp32, cache, next position P)."""
+    if cfg.num_experts > 0:
+        raise ValueError("KV-cache decode does not support MoE blocks yet")
+    b, plen = prompt_ids.shape
+    cache = init_kv_cache(cfg, b, max_len)
+    cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
+    positions = jnp.arange(plen)
+    h, dh = cfg.num_heads, cfg.d_head
+
+    from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
+
+    x = embedding(params["token_embeddings"], prompt_ids, cfg.cdtype)
+    mask = causal_mask(plen, plen)
+
+    def body(carry, bp):
+        x = carry
+        hsplit = lambda t: t.reshape(b, plen, h, dh).transpose(0, 2, 1, 3)
+        hx = rmsnorm(bp["ln1"], x)
+        q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
+        k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
+        v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        attn = attention_with_lse(q, k, v, mask)[0]
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * dh)
+        x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+        x = x + swiglu(bp["ffn"], rmsnorm(bp["ln2"], x), cfg.cdtype)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    x = rmsnorm(params["ln_final"], x)
+    logits = linear(params["lm_head"], x, cfg.cdtype)[:, -1].astype(jnp.float32)
+
+    # write the [L, B, H, P, Dh] prompt K/V into the S_max cache prefix
+    cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
+    }
+    return logits, cache, plen
+
+
+def _sample(logits, key, temperature: float, top_k: int | None):
+    """Reference sampling semantics (model.py:292-303): temperature scale,
+    top-k threshold mask, categorical draw."""
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
+def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
+                   temperature, top_k):
+    logits, cache, pos = prefill(params, prompt_ids, cfg)
+
+    def step(carry, _):
+        cache, pos, logits, key = carry
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sub, temperature, top_k).astype(jnp.int32)
+        new_logits, cache = decode_step(params, cache, pos, nxt, cfg)
+        return (cache, pos + 1, new_logits, key), nxt
+
+    (_, _, _, _), tokens = jax.lax.scan(
+        step, (cache, jnp.asarray(pos, jnp.int32), logits, key),
+        None, length=max_new_tokens,
+    )
+    return tokens.T  # [B, T]
+
+
+def generate_kv(
+    params,
+    cfg: TransformerConfig,
+    prompt_ids,
+    max_new_tokens: int,
+    key,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    eos_token_id: int | None = None,
+) -> jax.Array:
+    """KV-cached sampling — same contract as ``transformer.generate`` (the
+    reference semantics) but one jit for the whole generation. 1-D prompt in
+    → 1-D tokens out, truncated at EOS on the host.
+
+    Note: prompt + max_new_tokens must fit the context window (the cache is
+    the window); the uncached ``generate`` additionally supports sliding-
+    window truncation for longer generations.
+    """
+    ids = jnp.asarray(prompt_ids, jnp.int32).reshape(1, -1)
+    total = ids.shape[1] + max_new_tokens
+    if total > cfg.context_length:
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds context_length={cfg.context_length}; use generate() "
+            "for sliding-window decoding"
+        )
+    if cfg.num_experts > 0:
+        raise ValueError("KV-cache decode does not support MoE blocks yet")
+    # (decode_step/prefill re-check this for direct callers)
+    tokens = _generate_scan(
+        params, ids, key, cfg, max_new_tokens, float(temperature), top_k
+    )[0]
+    if eos_token_id is not None:
+        hits = jnp.where(tokens == eos_token_id)[0]
+        if hits.size:
+            tokens = tokens[: int(hits[0])]
+    return tokens
