@@ -16,6 +16,7 @@ Timing fences are hard device_get fences (utils/timing.py).
 from __future__ import annotations
 
 import argparse
+import gc
 from typing import Iterable
 
 import jax
@@ -36,7 +37,7 @@ from cs336_systems_tpu.utils.timing import (
     error_cell,
     print_table,
     results_table,
-    timed,
+    timed_total,
 )
 
 
@@ -73,25 +74,37 @@ def benchmark_lm_size(
 
     fwd = maybe_jit(lambda p: lm_loss(p, x, y, cfg))
     fwd_bwd = maybe_jit(jax.value_and_grad(lambda p: lm_loss(p, x, y, cfg)))
+    # The mutating phases donate their params/opt inputs and thread outputs
+    # back via carry: timed_total queues iterations WITHOUT fencing between
+    # them (that is the point), so undonated iterations would hold several
+    # multi-GB (params', opt') output sets in flight at once — measured OOM
+    # at the "medium" size. Donation keeps one live copy regardless of
+    # queue depth. Consequently the donating phases run last, with the
+    # optimizer-only phase consuming the step phase's surviving buffers.
     step = (
-        make_train_step(cfg, hp, clip_norm=None, donate=False)
+        make_train_step(cfg, hp, clip_norm=None, donate=True)
         if use_jit
         else (lambda p, o, xx, yy: _eager_step(p, o, xx, yy, cfg, hp))
     )
-    opt_only = maybe_jit(lambda p, g, o: adamw_update(p, g, o, hp))
-
-    t_fwd, _ = timed(fwd, params, warmup=warmup, iters=iters)
-    t_fb, (_, grads) = timed(fwd_bwd, params, warmup=warmup, iters=iters)
-    t_step, _ = timed(
-        step, params, opt, x, y, warmup=warmup, iters=iters,
-        carry=lambda out, args: (out[0], out[1], args[2], args[3]),
+    opt_only = (
+        jax.jit(
+            lambda p, g, o: adamw_update(p, g, o, hp), donate_argnums=(0, 2)
+        )
+        if use_jit
+        else (lambda p, g, o: adamw_update(p, g, o, hp))
     )
-    t_opt, _ = timed(opt_only, params, grads, opt, warmup=warmup, iters=iters)
 
+    # timed_total (one fence around the loop): per-iteration fences pay a
+    # host round-trip per output LEAF, which on remote-dispatch runtimes
+    # costs more than the step itself (observed 20x inflation).
+    # Drop every timing's output as soon as it is measured: at the larger
+    # sizes a lingering (params', opt') copy from one phase plus the next
+    # phase's working set exceeds HBM (each copy is ~3 bytes/param fp32 m/v
+    # + 4 bytes/param weights).
     def cell(t: TimingResult) -> str:
         return f"{t.mean_ms:.2f}±{t.std_ms:.2f}"
 
-    return {
+    row = {
         "size": size,
         "params_M": round(count_params(params) / 1e6, 1),
         "ctx": context_length,
@@ -99,13 +112,57 @@ def benchmark_lm_size(
         "dtype": compute_dtype,
         "attn": attn_impl,
         "jit": use_jit,
-        "forward_ms": cell(t_fwd),
-        "fwd_bwd_ms": cell(t_fb),
-        "backward_ms": f"{max(t_fb.mean_ms - t_fwd.mean_ms, 0.0):.2f}",
-        "full_step_ms": cell(t_step),
-        "optimizer_ms": cell(t_opt),
-        "tokens_per_sec": round(batch_size * context_length / (t_step.mean_ms / 1e3), 1),
     }
+    # phases fail independently (OOM recorded per cell, like the reference's
+    # benchmark_attention OOM-catch): a size whose full AdamW state exceeds
+    # HBM still reports its forward numbers
+    t_fwd = None
+    try:
+        t_fwd, out = timed_total(fwd, params, warmup=warmup, iters=iters)
+        del out
+        row["forward_ms"] = cell(t_fwd)
+    except Exception as e:
+        row["forward_ms"] = error_cell(e)
+    grads = None
+    try:
+        t_fb, out = timed_total(fwd_bwd, params, warmup=warmup, iters=iters)
+        grads = out[1]
+        del out
+        row["fwd_bwd_ms"] = cell(t_fb)
+        if t_fwd is not None:
+            row["backward_ms"] = f"{max(t_fb.mean_ms - t_fwd.mean_ms, 0.0):.2f}"
+    except Exception as e:
+        row["fwd_bwd_ms"] = error_cell(e)
+    step_ok = False
+    try:
+        t_step, out = timed_total(
+            step, params, opt, x, y, warmup=warmup, iters=iters,
+            carry=lambda out, args: (out[0], out[1], args[2], args[3]),
+        )
+        params, opt = out[0], out[1]  # survivors of the donating step phase
+        del out
+        row["full_step_ms"] = cell(t_step)
+        row["tokens_per_sec"] = round(
+            batch_size * context_length / (t_step.mean_ms / 1e3), 1
+        )
+        step_ok = True
+    except Exception as e:
+        row["full_step_ms"] = error_cell(e)
+    if grads is None:
+        row["optimizer_ms"] = "skipped (fwd_bwd failed)"
+    elif not step_ok:
+        row["optimizer_ms"] = "skipped (full step failed)"
+    else:
+        try:
+            t_opt, out = timed_total(
+                opt_only, params, grads, opt, warmup=warmup, iters=iters,
+                carry=lambda out, args: (out[0], args[1], out[1]),
+            )
+            del out
+            row["optimizer_ms"] = cell(t_opt)
+        except Exception as e:
+            row["optimizer_ms"] = error_cell(e)
+    return row
 
 
 def _eager_step(params, opt, x, y, cfg: TransformerConfig, hp: AdamWHparams):
@@ -133,6 +190,7 @@ def run_lm_benchmark(
         for dtype in dtypes:
             for attn in attn_impls:
                 for use_jit in jit_modes:
+                    gc.collect()  # release the previous cell's buffers
                     try:
                         rows.append(
                             benchmark_lm_size(
