@@ -37,6 +37,7 @@ from cs336_systems_tpu.utils.timing import (
     error_cell,
     print_table,
     results_table,
+    timed,
     timed_total,
 )
 
@@ -64,7 +65,6 @@ def benchmark_lm_size(
     key = jax.random.PRNGKey(seed)
     params = init_transformer_lm(key, cfg)
     hp = AdamWHparams(lr=1e-4)
-    opt = adamw_init(params)
 
     kx, ky = jax.random.split(jax.random.PRNGKey(seed + 1))
     x = jax.random.randint(kx, (batch_size, context_length), 0, vocab_size)
@@ -74,13 +74,13 @@ def benchmark_lm_size(
 
     fwd = maybe_jit(lambda p: lm_loss(p, x, y, cfg))
     fwd_bwd = maybe_jit(jax.value_and_grad(lambda p: lm_loss(p, x, y, cfg)))
-    # The mutating phases donate their params/opt inputs and thread outputs
-    # back via carry: timed_total queues iterations WITHOUT fencing between
-    # them (that is the point), so undonated iterations would hold several
-    # multi-GB (params', opt') output sets in flight at once — measured OOM
-    # at the "medium" size. Donation keeps one live copy regardless of
-    # queue depth. Consequently the donating phases run last, with the
-    # optimizer-only phase consuming the step phase's surviving buffers.
+    # The mutating (jit) phases donate their params/opt inputs and thread
+    # outputs back via carry: timed_total queues iterations WITHOUT fencing
+    # between them (that is the point), so undonated iterations would hold
+    # several multi-GB (params', opt') output sets in flight at once —
+    # measured OOM at the "medium" size. Donation keeps one live copy
+    # regardless of queue depth. The EAGER path cannot donate, so it uses
+    # the per-iteration-fenced timer, which bounds in-flight copies to one.
     step = (
         make_train_step(cfg, hp, clip_norm=None, donate=True)
         if use_jit
@@ -93,14 +93,8 @@ def benchmark_lm_size(
         if use_jit
         else (lambda p, g, o: adamw_update(p, g, o, hp))
     )
+    timer = timed_total if use_jit else timed
 
-    # timed_total (one fence around the loop): per-iteration fences pay a
-    # host round-trip per output LEAF, which on remote-dispatch runtimes
-    # costs more than the step itself (observed 20x inflation).
-    # Drop every timing's output as soon as it is measured: at the larger
-    # sizes a lingering (params', opt') copy from one phase plus the next
-    # phase's working set exceeds HBM (each copy is ~3 bytes/param fp32 m/v
-    # + 4 bytes/param weights).
     def cell(t: TimingResult) -> str:
         return f"{t.mean_ms:.2f}±{t.std_ms:.2f}"
 
@@ -113,21 +107,40 @@ def benchmark_lm_size(
         "attn": attn_impl,
         "jit": use_jit,
     }
-    # phases fail independently (OOM recorded per cell, like the reference's
-    # benchmark_attention OOM-catch): a size whose full AdamW state exceeds
-    # HBM still reports its forward numbers
+    # Phases fail independently (OOM recorded per cell, like the reference's
+    # benchmark_attention OOM-catch), ordered by working-set size so a model
+    # whose AdamW state exceeds HBM still reports forward/backward numbers:
+    # optimizer state is allocated only inside the full-step phase, and the
+    # fwd_bwd gradients are dropped before it (recomputed once for the
+    # optimizer-only phase).
     t_fwd = None
     try:
-        t_fwd, out = timed_total(fwd, params, warmup=warmup, iters=iters)
+        t_fwd, out = timer(fwd, params, warmup=warmup, iters=iters)
         del out
         row["forward_ms"] = cell(t_fwd)
     except Exception as e:
         row["forward_ms"] = error_cell(e)
-    grads = None
+    fb_ok = False
     try:
-        t_fb, out = timed_total(fwd_bwd, params, warmup=warmup, iters=iters)
-        grads = out[1]
-        del out
+        if use_jit:
+            # Each queued iteration's gradient output (a full params-sized
+            # pytree) donates the PREVIOUS iteration's, so in-flight grads
+            # stay bounded at one copy regardless of queue depth.
+            fb_recycling = jax.jit(
+                lambda p, g_dead: jax.value_and_grad(
+                    lambda q: lm_loss(q, x, y, cfg)
+                )(p),
+                donate_argnums=(1,),
+            )
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+            t_fb, out = timer(
+                fb_recycling, params, g0, warmup=warmup, iters=iters,
+                carry=lambda out, args: (args[0], out[1]),
+            )
+        else:
+            t_fb, out = timer(fwd_bwd, params, warmup=warmup, iters=iters)
+        del out  # grads dropped: holding them would inflate the step phase
+        fb_ok = True
         row["fwd_bwd_ms"] = cell(t_fb)
         if t_fwd is not None:
             row["backward_ms"] = f"{max(t_fb.mean_ms - t_fwd.mean_ms, 0.0):.2f}"
@@ -135,7 +148,8 @@ def benchmark_lm_size(
         row["fwd_bwd_ms"] = error_cell(e)
     step_ok = False
     try:
-        t_step, out = timed_total(
+        opt = adamw_init(params)
+        t_step, out = timer(
             step, params, opt, x, y, warmup=warmup, iters=iters,
             carry=lambda out, args: (out[0], out[1], args[2], args[3]),
         )
@@ -148,17 +162,16 @@ def benchmark_lm_size(
         step_ok = True
     except Exception as e:
         row["full_step_ms"] = error_cell(e)
-    if grads is None:
-        row["optimizer_ms"] = "skipped (fwd_bwd failed)"
-    elif not step_ok:
-        row["optimizer_ms"] = "skipped (full step failed)"
+    if not (fb_ok and step_ok):
+        row["optimizer_ms"] = "skipped (earlier phase failed)"
     else:
         try:
-            t_opt, out = timed_total(
+            grads = fwd_bwd(params)[1]  # recomputed once for this phase
+            t_opt, out = timer(
                 opt_only, params, grads, opt, warmup=warmup, iters=iters,
                 carry=lambda out, args: (out[0], args[1], out[1]),
             )
-            del out
+            del out, grads
             row["optimizer_ms"] = cell(t_opt)
         except Exception as e:
             row["optimizer_ms"] = error_cell(e)

@@ -63,6 +63,9 @@ def profile_memory_cell(
         memory_snapshot(os.path.join(snapshot_dir, f"{tag}.pb.gz"))
 
     stats = memory_stats()
+    from cs336_systems_tpu.utils.profiling import live_buffer_bytes
+
+    in_use = stats.get("bytes_in_use", 0) or live_buffer_bytes()
     return {
         "size": size,
         "ctx": context_length,
@@ -70,9 +73,11 @@ def profile_memory_cell(
         "dtype": compute_dtype,
         # Valid as THIS cell's peak only when the process ran just this
         # cell (isolate=True, the default sweep mode): the backend peak
-        # counter is process-lifetime-monotonic with no reset API.
+        # counter is process-lifetime-monotonic with no reset API. Backends
+        # without allocator stats (some PJRT plugins) report live-array
+        # bytes as in_use and 0 peak.
         "peak_mb": round(peak_bytes() / 2**20, 1),
-        "in_use_mb": round(stats.get("bytes_in_use", 0) / 2**20, 1),
+        "in_use_mb": round(in_use / 2**20, 1),
         "limit_mb": round(stats.get("bytes_limit", 0) / 2**20, 1),
     }
 
@@ -149,6 +154,10 @@ def main(argv=None) -> None:
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--snapshot-dir", default="memory_files")
+    p.add_argument("--no-snapshots", dest="snapshots", action="store_false",
+                   help="skip device_memory_profile dumps (some PJRT "
+                        "plugins hard-abort on the heap-profile C API); "
+                        "peak/live byte accounting still runs")
     p.add_argument("--no-isolate", action="store_true",
                    help="share one process (peaks become upper bounds)")
     p.add_argument("--cell", default=None, help=argparse.SUPPRESS)  # internal
@@ -168,7 +177,8 @@ def main(argv=None) -> None:
 
     df = run_memory_benchmark(
         size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
-        batch_size=args.batch, snapshot_dir=args.snapshot_dir,
+        batch_size=args.batch,
+        snapshot_dir=args.snapshot_dir if args.snapshots else None,
         isolate=not args.no_isolate,
     )
     print_table(df)

@@ -38,12 +38,13 @@ class TimingResult:
 def _fence(out: Any) -> None:
     """Hard host↔device fence over the output tree.
 
-    ``block_until_ready`` waits on every leaf, then ONE one-element
-    ``device_get`` guards against transports whose ready-signal has been
-    observed to return early (a single leaf suffices: jitted outputs come
-    from one executable, so any output value existing implies the
-    computation ran). A per-leaf device_get would cost a host round-trip
-    per leaf — hundreds of ms per call on remote-dispatch runtimes.
+    ``block_until_ready`` waits on every leaf; then one-element heads of
+    EVERY leaf are fetched in a single batched ``device_get``, guarding
+    against transports whose ready-signal has been observed to return
+    early. All leaves must be fenced (eager/multi-dispatch outputs are
+    independent computations), but batching the fetch keeps it to two host
+    round-trips total instead of one per leaf — per-leaf device_gets cost
+    hundreds of ms per call on remote-dispatch runtimes.
     """
     jax.block_until_ready(out)
     heads = [
