@@ -76,3 +76,24 @@ def adamw_update(params, grads, state, hp: AdamWHparams, lr=None):
         lambda t3: t3[i], triples, is_leaf=lambda x: isinstance(x, tuple)
     )
     return pick(0), {"m": pick(1), "v": pick(2), "t": t}
+
+
+def adamw_chunk_update(p, g, m, v, t, hp: AdamWHparams, lr=None):
+    """One AdamW step on a flat fp32 chunk: the shared update body of the
+    index-sharded optimizers (``parallel.zero`` ZeRO-1, ``parallel.fsdp``
+    ZeRO-3). Same arithmetic as ``adamw_update``'s per-leaf body — kept in
+    ONE place so the sharded variants cannot drift from the canonical
+    update (their bit-exactness vs ``adamw_update`` is test-pinned).
+
+    ``t`` is the PRE-increment step counter; returns (p, m, v, t+1).
+    """
+    lr = hp.lr if lr is None else lr
+    t = t + 1
+    tf = t.astype(jnp.float32)
+    b1, b2 = hp.beta1, hp.beta2
+    alpha_t = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    p = p - alpha_t * m / (jnp.sqrt(v) + hp.eps)
+    p = p - lr * hp.weight_decay * p
+    return p, m, v, t

@@ -34,7 +34,7 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cs336_systems_tpu.models.transformer import TransformerConfig
-from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_chunk_update
 
 
 def greedy_param_assignment(params, world_size: int) -> list[int]:
@@ -128,27 +128,23 @@ def _build_zero1_step(
         g_chunk = jax.lax.psum_scatter(flat_g, axis, tiled=True) / world
 
         if clip_norm is not None:
-            # global norm needs the full gradient: psum of local chunk sq-sums
-            sq = jax.lax.psum(jnp.sum(jnp.square(g_chunk)), axis)
-            norm = jnp.sqrt(sq)
-            g_chunk = g_chunk * jnp.minimum(1.0, clip_norm / (norm + 1e-6))
+            # global norm needs the full gradient: psum of local chunk sq-sums;
+            # the clip FORMULA stays in ops.nn (norm= seam for shard-local leaves)
+            from cs336_systems_tpu.ops.nn import clip_gradients
+
+            norm = jnp.sqrt(jax.lax.psum(jnp.sum(jnp.square(g_chunk)), axis))
+            g_chunk = clip_gradients(g_chunk, clip_norm, norm=norm)
 
         rank = jax.lax.axis_index(axis)
         p_chunk = jax.lax.dynamic_slice(
             jnp.pad(flat_p, (0, pad)), (rank * chunk,), (chunk,)
         ).astype(jnp.float32)
 
-        m = zstate["m"][0]
-        v = zstate["v"][0]
-        t = zstate["t"] + 1
-        tf = t.astype(jnp.float32)
         lr = hp.lr if lr_schedule is None else lr_schedule(zstate["t"])
-        b1, b2 = hp.beta1, hp.beta2
-        alpha_t = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
-        m = b1 * m + (1.0 - b1) * g_chunk
-        v = b2 * v + (1.0 - b2) * jnp.square(g_chunk)
-        p_chunk = p_chunk - alpha_t * m / (jnp.sqrt(v) + hp.eps)
-        p_chunk = p_chunk - lr * hp.weight_decay * p_chunk
+        p_chunk, m, v, t = adamw_chunk_update(
+            p_chunk, g_chunk, zstate["m"][0], zstate["v"][0],
+            zstate["t"], hp, lr=lr,
+        )
 
         # all-gather the updated chunks back into the replicated flat params
         flat_new = jax.lax.all_gather(p_chunk, axis, tiled=True)[:n]

@@ -46,9 +46,11 @@ def make_update_fn(
     step counter → AdamW. The single-device step, the multi-step loop, and
     the DP / TP / SP-ring train-step builders all wrap THIS function, so the
     update semantics — clip placement, schedule indexing, decay arithmetic —
-    cannot drift between those variants. (ZeRO-1 is the one exception: it
-    re-expresses the same update on reduce-scattered flat chunks, and its
-    bit-exactness against the unsharded path is pinned by test instead.)
+    cannot drift between those variants. (The index-sharded optimizers —
+    ZeRO-1 and FSDP — are the exception: they apply the same update to
+    reduce-scattered flat chunks via the shared ``adamw_chunk_update``
+    body, and their bit-exactness against the unsharded path is pinned by
+    test instead.)
 
     ``loss_fn``: ``(params, x, y) -> scalar loss``. Distributed variants that
     must own their gradient communication pass ``value_and_grad`` instead —
