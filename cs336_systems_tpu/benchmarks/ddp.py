@@ -1,5 +1,5 @@
 """Data-parallel training benchmark: step time, communication share, and
-memory at phase boundaries for every DP variant (± ZeRO-1).
+memory at phase boundaries for every DP variant (± ZeRO-1, ± FSDP/ZeRO-3).
 
 Reference parity (cs336_systems/ddp_bucketed_overlapped_sharded.py:366-419
 and naive_ddp.py:372-438): argparse flags pick the variant; small-GPT
@@ -85,6 +85,7 @@ def benchmark_variant(
     mesh,
     variant: str,
     sharded: bool = False,
+    fsdp: bool = False,
     batch_size: int = 128,
     warmup: int = 2,
     steps: int = 10,
@@ -93,11 +94,24 @@ def benchmark_variant(
     hp = AdamWHparams(lr=3e-4)
     mem0 = live_buffer_bytes()
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
-    if sharded:
-        opt = zero1_init(params, mesh)
+    if fsdp:
+        # FSDP / ZeRO-3: params live only as 1/N fp32 chunks inside the
+        # state; the replicated init tree is dropped before timing so the
+        # memory rows show the actual at-rest footprint.
+        from cs336_systems_tpu.parallel.fsdp import fsdp_init, make_fsdp_train_step
+
+        opt = fsdp_init(params, mesh)
+        raw = make_fsdp_train_step(cfg, hp, mesh, donate=False, params_like=params)
+
+        def step(params, state, x, y):
+            state, loss = raw(state, x, y)
+            return params, state, loss
+
+        label = "fsdp"
+        params = ()
     else:
-        opt = adamw_init(params)
-    step, label = _make_step(cfg, hp, mesh, variant, sharded, bucket_mb)
+        opt = zero1_init(params, mesh) if sharded else adamw_init(params)
+        step, label = _make_step(cfg, hp, mesh, variant, sharded, bucket_mb)
     mem_after_init = live_buffer_bytes()
 
     x = jax.random.randint(
@@ -132,6 +146,8 @@ def main(argv=None) -> None:
                    choices=["naive", "flat", "bucketed", "nosync"])
     p.add_argument("--sharded", action="store_true",
                    help="also run the ZeRO-1 sharded-optimizer step")
+    p.add_argument("--fsdp", action="store_true",
+                   help="also run the FSDP / ZeRO-3 fully-sharded step")
     p.add_argument("--no-comm-split", dest="comm_split", action="store_false",
                    help="skip the nosync differential row")
     p.add_argument("--dp", type=int, default=None,
@@ -176,6 +192,13 @@ def main(argv=None) -> None:
         rows.append(
             benchmark_variant(
                 cfg, mesh, "bucketed", sharded=True, batch_size=args.batch,
+                warmup=args.warmup, steps=args.steps,
+            )
+        )
+    if args.fsdp:
+        rows.append(
+            benchmark_variant(
+                cfg, mesh, "bucketed", fsdp=True, batch_size=args.batch,
                 warmup=args.warmup, steps=args.steps,
             )
         )

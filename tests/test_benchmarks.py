@@ -149,12 +149,13 @@ def test_ddp_benchmark_cli_smoke(capsys):
     from cs336_systems_tpu.benchmarks.ddp import main
 
     main([
-        "--variants", "naive", "--sharded", "--batch", "8", "--ctx", "32",
+        "--variants", "naive", "--sharded", "--fsdp", "--batch", "8",
+        "--ctx", "32",
         "--steps", "1", "--warmup", "1", "--layers", "2", "--dp", "4",
         "--d-model", "64", "--d-ff", "128", "--heads", "4", "--vocab", "128",
     ])
     out = capsys.readouterr().out
-    for token in ("naive", "nosync", "zero1", "step_ms", "comm_pct"):
+    for token in ("naive", "nosync", "zero1", "fsdp", "step_ms", "comm_pct"):
         assert token in out, f"missing {token!r} in DDP benchmark output"
 
 
