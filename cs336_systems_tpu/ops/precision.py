@@ -17,9 +17,12 @@ Reference surface → here:
   output, logits, loss, grads).
 
 TPU notes: bf16 is the MXU-native input dtype; accumulation inside the MXU
-is fp32 (``preferred_element_type``), so the *torch-fp16 + GradScaler*
-pattern has no TPU equivalent — bf16's fp32-sized exponent makes loss
-scaling unnecessary, which is why only bf16/fp32 policies are offered.
+is fp32 (``preferred_element_type``), and bf16's fp32-sized exponent makes
+loss scaling unnecessary — bf16/fp32 policies are the recommended path.
+The torch-fp16 + GradScaler capability is still provided (``MIXED_FP16`` +
+the functional dynamic loss scaler below) for fp16 parity: scale the loss
+up so gradients clear fp16's underflow floor, skip steps that overflow,
+adapt the scale.
 """
 
 from __future__ import annotations
@@ -66,8 +69,127 @@ class Policy:
 FP32 = Policy()
 MIXED_BF16 = Policy(param_dtype="float32", compute_dtype="bfloat16")
 PURE_BF16 = Policy(param_dtype="bfloat16", compute_dtype="bfloat16")
+MIXED_FP16 = Policy(param_dtype="float32", compute_dtype="float16")
 
-POLICIES = {"fp32": FP32, "mixed_bf16": MIXED_BF16, "pure_bf16": PURE_BF16}
+POLICIES = {
+    "fp32": FP32,
+    "mixed_bf16": MIXED_BF16,
+    "pure_bf16": PURE_BF16,
+    "mixed_fp16": MIXED_FP16,
+}
+
+
+# ---------------------------------------------------------------------------
+# Dynamic loss scaling (torch GradScaler capability, functional form)
+#
+# bf16 does not need it (fp32-sized exponent — the note above stands), but
+# fp16 compute does: small gradients underflow fp16's 2^-24 floor. The
+# scaler multiplies the loss by ``scale`` before differentiation (shifting
+# gradients up into fp16 range), unscales outside the fp16 region, skips
+# the optimizer step when any gradient is non-finite (overflow at the top
+# of the range), and adapts: halve on overflow, double after
+# ``growth_interval`` consecutive finite steps.
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScalerConfig:
+    init_scale: float = 2.0**15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 200
+    min_scale: float = 1.0
+    max_scale: float = 2.0**24
+
+
+def loss_scaler_init(cfg: LossScalerConfig = LossScalerConfig()):
+    return {
+        "scale": jnp.asarray(cfg.init_scale, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+    }
+
+
+def grads_finite(grads) -> jax.Array:
+    """True iff every element of every gradient leaf is finite."""
+    leaves = [
+        jnp.all(jnp.isfinite(leaf)) for leaf in jax.tree_util.tree_leaves(grads)
+    ]
+    return jnp.stack(leaves).all() if leaves else jnp.asarray(True)
+
+
+def loss_scaler_update(state, finite, cfg: LossScalerConfig = LossScalerConfig()):
+    """Advance the scaler state after one step whose gradients were
+    ``finite`` (bool scalar, traced): backoff on overflow, grow after
+    ``growth_interval`` consecutive good steps."""
+    good = jnp.where(finite, state["good_steps"] + 1, 0)
+    grow = good >= cfg.growth_interval
+    scale = jnp.where(
+        finite,
+        jnp.where(grow, state["scale"] * cfg.growth_factor, state["scale"]),
+        state["scale"] * cfg.backoff_factor,
+    )
+    scale = jnp.clip(scale, cfg.min_scale, cfg.max_scale)
+    return {"scale": scale, "good_steps": jnp.where(grow, 0, good)}
+
+
+def scaled_value_and_grad(loss_fn, state):
+    """``value_and_grad`` of ``scale * loss_fn`` with the gradients unscaled
+    back in fp32. Returns ``(loss, grads, finite)`` — the loss is the
+    UNscaled value; ``finite`` reports whether the scaled backward stayed
+    in range (the caller should skip its optimizer step and back off the
+    scale when it did not)."""
+
+    def fn(params, *batch):
+        scale = state["scale"]
+        loss, grads = jax.value_and_grad(
+            lambda p, *b: loss_fn(p, *b) * scale
+        )(params, *batch)
+        inv = 1.0 / scale
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads
+        )
+        return loss * inv, grads, grads_finite(grads)
+
+    return fn
+
+
+def make_scaled_update_fn(
+    loss_fn,
+    hp,
+    cfg: LossScalerConfig = LossScalerConfig(),
+    clip_norm: float | None = 1.0,
+    lr_schedule=None,
+):
+    """A train-step body with dynamic loss scaling:
+    ``(params, opt_state, scaler, x, y) -> (params, opt_state, scaler,
+    loss, finite)``. On overflow the params/opt state pass through
+    unchanged (the skipped step) and the scale backs off; otherwise the
+    canonical AdamW update applies. Works under ``jax.jit``.
+
+    Like the index-sharded optimizers, this is a deliberate exception to
+    wrapping ``train.make_update_fn`` (the skip-on-overflow select cannot
+    be expressed through its interface); the clip → schedule → AdamW
+    ordering below mirrors ``make_update_fn`` line for line and the skip
+    semantics are pinned by test."""
+    from cs336_systems_tpu.ops.nn import clip_gradients
+    from cs336_systems_tpu.optim.adamw import adamw_update
+
+    def update(params, opt_state, scaler, *batch):
+        loss, grads, finite = scaled_value_and_grad(loss_fn, scaler)(
+            params, *batch
+        )
+        if clip_norm is not None:
+            grads = clip_gradients(grads, clip_norm)
+        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+        new_params, new_opt = adamw_update(params, grads, opt_state, hp, lr=lr)
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(finite, a, b), new, old
+        )
+        params = pick(new_params, params)
+        opt_state = pick(new_opt, opt_state)
+        scaler = loss_scaler_update(scaler, finite, cfg)
+        return params, opt_state, scaler, loss, finite
+
+    return update
 
 
 def accumulate(

@@ -31,6 +31,39 @@ def lm_loss(params, x, y, cfg: TransformerConfig):
     return loss
 
 
+def make_accum_value_and_grad(loss_fn: Callable, accum_steps: int) -> Callable:
+    """``value_and_grad`` over microbatches: ``(params, x, y)`` where x/y
+    carry a leading ``[accum_steps, ...]`` microbatch dim. Grads (and the
+    loss) are averaged across microbatches with a ``lax.scan`` — activation
+    memory is that of ONE microbatch, the standard trade for training batch
+    sizes that do not fit. Equivalent to the full-batch gradient for
+    mean-reduced losses (equal microbatch sizes), which the tests pin.
+    """
+    vag = jax.value_and_grad(loss_fn)
+
+    def fn(params, x, y):
+        if x.shape[0] != accum_steps:
+            raise ValueError(
+                f"accum_steps={accum_steps} but x has leading microbatch "
+                f"dim {x.shape[0]} — reshape to [accum_steps, micro, ...]"
+            )
+
+        def micro(carry, batch):
+            loss_acc, grad_acc = carry
+            loss, grads = vag(params, *batch)
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            return (loss_acc + loss, grad_acc), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params
+        )
+        (loss, grads), _ = jax.lax.scan(micro, (jnp.zeros(()), zeros), (x, y))
+        inv = 1.0 / accum_steps
+        return loss * inv, jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    return fn
+
+
 def make_update_fn(
     loss_fn: Callable | None,
     hp: AdamWHparams,
@@ -38,6 +71,7 @@ def make_update_fn(
     lr_schedule: Callable | None = None,
     *,
     value_and_grad: Callable | None = None,
+    accum_steps: int = 1,
 ) -> Callable:
     """The one canonical step body: ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
@@ -56,9 +90,21 @@ def make_update_fn(
     must own their gradient communication pass ``value_and_grad`` instead —
     ``(params, x, y) -> (loss, grads)`` with any collective sync already
     applied (e.g. DP's explicit pmean variants).
+
+    ``accum_steps > 1``: gradient accumulation — x/y gain a leading
+    ``[accum_steps, ...]`` microbatch dim and the update applies the
+    microbatch-averaged gradient (see ``make_accum_value_and_grad``).
     """
+    if value_and_grad is not None and accum_steps > 1:
+        raise ValueError(
+            "pass either value_and_grad or accum_steps, not both — wrap the "
+            "custom value_and_grad in your own accumulation instead"
+        )
     if value_and_grad is None:
-        value_and_grad = jax.value_and_grad(loss_fn)
+        if accum_steps > 1:
+            value_and_grad = make_accum_value_and_grad(loss_fn, accum_steps)
+        else:
+            value_and_grad = jax.value_and_grad(loss_fn)
 
     def update(params, opt_state, x, y):
         loss, grads = value_and_grad(params, x, y)
@@ -77,15 +123,19 @@ def make_train_step(
     clip_norm: float | None = 1.0,
     lr_schedule: Callable | None = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable:
     """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
     ``donate`` hands the old params/opt-state buffers back to XLA (they are
     consumed by the update anyway), halving the step's HBM high-water mark.
+    ``accum_steps > 1`` expects x/y shaped ``[accum_steps, micro_batch, S]``
+    and applies one optimizer step on the microbatch-averaged gradient.
     """
 
     update = make_update_fn(
-        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule,
+        accum_steps=accum_steps,
     )
     return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 

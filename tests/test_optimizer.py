@@ -160,3 +160,63 @@ def test_train_step_reduces_loss():
         params, opt_state, loss = step(params, opt_state, x, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Gradient accumulation
+
+
+def test_accum_grads_match_full_batch():
+    """Microbatch-accumulated gradients equal the full-batch gradient for a
+    mean-reduced loss (equal microbatch sizes)."""
+    from cs336_systems_tpu.train import make_accum_value_and_grad
+
+    from common import mse_loss, toy_model_apply, toy_model_init
+
+    params, _ = toy_model_init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 10)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((16, 5)).astype(np.float32))
+
+    loss_fn = lambda p, xx, yy: mse_loss(toy_model_apply, p, xx, yy)
+    full_loss, full_grads = jax.value_and_grad(loss_fn)(params, x, y)
+
+    acc = make_accum_value_and_grad(loss_fn, 4)
+    a_loss, a_grads = jax.jit(acc)(
+        params, x.reshape(4, 4, 10), y.reshape(4, 4, 5)
+    )
+    np.testing.assert_allclose(float(a_loss), float(full_loss), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(a_grads), jax.tree_util.tree_leaves(full_grads)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+
+
+def test_accum_train_step_matches_full_batch_step():
+    """make_train_step(accum_steps=4) tracks the full-batch step over
+    several updates on the LM."""
+    from cs336_systems_tpu.models.transformer import TransformerConfig
+    from cs336_systems_tpu.train import init_train_state, make_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=32, context_length=16, d_model=32, num_layers=2,
+        num_heads=2, d_ff=64,
+    )
+    hp = AdamWHparams(lr=1e-3)
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    pa, oa = jax.tree_util.tree_map(lambda x: x, (params, opt))
+
+    full = make_train_step(cfg, hp, donate=False)
+    accum = make_train_step(cfg, hp, donate=False, accum_steps=4)
+
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = jnp.asarray(rng.integers(0, 32, (8, 16)), jnp.int32)
+        y = jnp.roll(x, -1, axis=-1)
+        params, opt, l_full = full(params, opt, x, y)
+        pa, oa, l_acc = accum(pa, oa, x.reshape(4, 2, 16), y.reshape(4, 2, 16))
+        np.testing.assert_allclose(float(l_acc), float(l_full), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)

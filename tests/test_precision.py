@@ -126,3 +126,113 @@ class TestModelUnderPolicy:
         l32 = float(lm_loss(params, ids, tgt, mk("float32")))
         l16 = float(lm_loss(params, ids, tgt, mk("bfloat16")))
         assert abs(l32 - l16) / abs(l32) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# Dynamic loss scaling
+
+
+def test_loss_scaler_state_machine():
+    """Backoff on overflow, growth after the interval, clipping at bounds."""
+    import jax.numpy as jnp
+
+    from cs336_systems_tpu.ops.precision import (
+        LossScalerConfig,
+        loss_scaler_init,
+        loss_scaler_update,
+    )
+
+    cfg = LossScalerConfig(init_scale=1024.0, growth_interval=3)
+    s = loss_scaler_init(cfg)
+    assert float(s["scale"]) == 1024.0
+
+    s = loss_scaler_update(s, jnp.asarray(False), cfg)  # overflow -> halve
+    assert float(s["scale"]) == 512.0 and int(s["good_steps"]) == 0
+
+    for _ in range(2):
+        s = loss_scaler_update(s, jnp.asarray(True), cfg)
+        assert float(s["scale"]) == 512.0
+    s = loss_scaler_update(s, jnp.asarray(True), cfg)  # 3rd good -> double
+    assert float(s["scale"]) == 1024.0 and int(s["good_steps"]) == 0
+
+    tiny = loss_scaler_init(LossScalerConfig(init_scale=1.0, min_scale=1.0))
+    tiny = loss_scaler_update(
+        tiny, jnp.asarray(False), LossScalerConfig(min_scale=1.0)
+    )
+    assert float(tiny["scale"]) == 1.0  # clipped at min
+
+
+def test_scaled_grads_recover_fp16_underflow():
+    """A gradient below fp16's subnormal floor underflows to zero without
+    scaling and is recovered (vs fp32 oracle) with the scaler."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs336_systems_tpu.ops.precision import (
+        LossScalerConfig,
+        loss_scaler_init,
+        scaled_value_and_grad,
+    )
+
+    w = jnp.asarray(1.0, jnp.float32)
+    tiny = 1e-8  # below fp16's subnormal floor (~6e-8): flushes to zero
+
+    def loss_fn(w, x):
+        # fp16 compute region (as under the MIXED_FP16 policy), then an
+        # fp32 epilogue that makes the backward cotangent entering the
+        # fp16 region `tiny` — underflow in the COTANGENT chain is what
+        # loss scaling exists to fix.
+        prod = (w.astype(jnp.float16) * x.astype(jnp.float16)).astype(
+            jnp.float32
+        )
+        return prod * tiny
+
+    x = jnp.asarray(1.0, jnp.float32)
+    # unscaled: the fp32->fp16 cotangent cast flushes tiny to 0
+    _, g_plain = jax.value_and_grad(loss_fn)(w, x)
+    assert float(g_plain) == 0.0
+
+    state = loss_scaler_init(LossScalerConfig(init_scale=2.0**20))
+    loss, g_scaled, finite = jax.jit(
+        lambda w, x: scaled_value_and_grad(loss_fn, state)(w, x)
+    )(w, x)
+    assert bool(finite)
+    np.testing.assert_allclose(float(g_scaled), tiny, rtol=1e-3)
+
+
+def test_scaled_update_skips_nonfinite_step():
+    """An overflowing step must leave params/opt state untouched and back
+    the scale off; a finite step must apply the update."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+    from cs336_systems_tpu.ops.precision import (
+        LossScalerConfig,
+        loss_scaler_init,
+        make_scaled_update_fn,
+    )
+
+    def loss_fn(params, x):
+        return jnp.sum(params["w"] * x)
+
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = adamw_init(params)
+    cfg = LossScalerConfig(init_scale=4.0)
+    scaler = loss_scaler_init(cfg)
+    step = jax.jit(make_scaled_update_fn(loss_fn, AdamWHparams(lr=0.1), cfg))
+
+    # overflow: x = inf makes the gradient non-finite
+    p2, o2, s2, loss, finite = step(
+        params, opt, scaler, jnp.asarray([jnp.inf, 1.0, 1.0, 1.0])
+    )
+    assert not bool(finite)
+    np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+    assert int(o2["t"]) == 0  # skipped step does not advance the counter
+    assert float(s2["scale"]) == 2.0  # backed off
+
+    # finite: update applies, counter advances
+    p3, o3, s3, loss, finite = step(p2, o2, s2, jnp.ones((4,)))
+    assert bool(finite)
+    assert int(o3["t"]) == 1
+    assert not np.allclose(np.asarray(p3["w"]), np.asarray(p2["w"]))
