@@ -1,0 +1,138 @@
+"""Decoding benchmark: KV-cache incremental generation vs the reference's
+full-forward-per-token loop.
+
+The reference's only inference path is ``BasicsTransformerLM.generate``
+(model.py:255-310) — no KV cache, a full O(S²·L) forward per emitted token
+— and it ships no inference benchmark. This driver measures both paths so
+the capability gap is a recorded number: tokens/sec for (a) ``generate_kv``
+(prefill + cached decode, whole generation in ONE jit dispatch) and (b) an
+uncached loop with the same sampling semantics (temperature/top-k), plus
+the prefill latency on its own.
+
+Run: ``python -m cs336_systems_tpu.benchmarks.decode --size small
+--prompt 64 --new 128`` (defaults benchmark the flagship 125M config).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size, init_transformer_lm
+from cs336_systems_tpu.utils.timing import print_table, results_table, timed
+
+
+def _time_best(fn, reps: int = 3):
+    """Best-of-reps seconds via the shared fenced timer (utils.timing)."""
+    res, out = timed(fn, warmup=1, iters=reps)
+    return res.min_ms / 1e3, out
+
+
+def benchmark_decode(
+    size: str = "small",
+    prompt_len: int = 64,
+    new_tokens: int = 128,
+    uncached: bool = True,
+    reps: int = 3,
+) -> list[dict]:
+    from cs336_systems_tpu.models.decode import generate_kv, prefill
+    from cs336_systems_tpu.models.transformer import generate
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = config_for_size(
+        size,
+        context_length=max(512, prompt_len + new_tokens),
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        # decode attends through the masked-softmax op, not the Pallas
+        # kernel (single-row queries); xla is the right impl either way
+        attn_impl="xla",
+    )
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    prompt = list(range(1, prompt_len + 1))
+    key = jax.random.PRNGKey(7)
+    rows = []
+
+    # KV-cache path: whole generation in one jit
+    dt, toks = _time_best(
+        lambda: generate_kv(
+            params, cfg, prompt, new_tokens, key, temperature=0.8, top_k=50
+        ),
+        reps,
+    )
+    rows.append(
+        {
+            "path": "kv_cache",
+            "prompt": prompt_len,
+            "new_tokens": new_tokens,
+            "total_ms": round(dt * 1e3, 1),
+            "tokens_per_s": round(new_tokens / dt, 1),
+            "ms_per_token": round(dt * 1e3 / new_tokens, 2),
+        }
+    )
+
+    # prefill latency alone (cache build over the prompt); jit it — called
+    # standalone it would otherwise run eagerly, op by op
+    prefill_jit = jax.jit(lambda p, ids: prefill(p, ids, cfg))
+    dt_p, _ = _time_best(
+        lambda: prefill_jit(params, jnp.asarray([prompt])), reps
+    )
+    rows.append(
+        {
+            "path": "prefill_only",
+            "prompt": prompt_len,
+            "new_tokens": 0,
+            "total_ms": round(dt_p * 1e3, 1),
+            "tokens_per_s": round(prompt_len / dt_p, 1),
+            "ms_per_token": round(dt_p * 1e3 / prompt_len, 2),
+        }
+    )
+
+    if uncached:
+        # reference semantics: full forward per token (model.py:283-308)
+        dt_u, _ = _time_best(
+            lambda: generate(
+                params, cfg, prompt, new_tokens, key, temperature=0.8, top_k=50
+            ),
+            max(1, reps - 2),
+        )
+        rows.append(
+            {
+                "path": "uncached_loop",
+                "prompt": prompt_len,
+                "new_tokens": new_tokens,
+                "total_ms": round(dt_u * 1e3, 1),
+                "tokens_per_s": round(new_tokens / dt_u, 1),
+                "ms_per_token": round(dt_u * 1e3 / new_tokens, 2),
+            }
+        )
+        rows[0]["speedup_vs_uncached"] = round(dt_u / dt, 1)
+    return rows
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--size", default="small")
+    p.add_argument("--prompt", type=int, default=64)
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--no-uncached", dest="uncached", action="store_false",
+                   help="skip the slow full-forward-per-token baseline")
+    p.add_argument("--latex", default=None)
+    args = p.parse_args(argv)
+
+    rows = benchmark_decode(
+        size=args.size, prompt_len=args.prompt, new_tokens=args.new,
+        uncached=args.uncached, reps=args.reps,
+    )
+    df = results_table(rows, args.latex)
+    print_table(df)
+
+
+if __name__ == "__main__":
+    main()

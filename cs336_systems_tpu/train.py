@@ -51,11 +51,17 @@ def make_accum_value_and_grad(loss_fn: Callable, accum_steps: int) -> Callable:
         def micro(carry, batch):
             loss_acc, grad_acc = carry
             loss, grads = vag(params, *batch)
-            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            # accumulate in fp32 regardless of param/grad dtype: summing
+            # many bf16 microbatch grads in bf16 compounds rounding error
+            # the full-batch path does not have (AdamW upcasts to fp32
+            # anyway, so fp32 grads feed the update losslessly)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+            )
             return (loss_acc + loss, grad_acc), None
 
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
         (loss, grads), _ = jax.lax.scan(micro, (jnp.zeros(()), zeros), (x, y))
         inv = 1.0 / accum_steps

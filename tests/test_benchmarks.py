@@ -181,3 +181,16 @@ def test_named_scopes_in_hlo():
     )
     for scope in ("attn", "ffn", "embed", "lm_head", "sdpa"):
         assert scope in hlo, f"named_scope {scope!r} missing from HLO"
+
+
+def test_decode_benchmark_cli_smoke(capsys, monkeypatch):
+    """The decode benchmark driver runs end-to-end (tiny shapes) and prints
+    all three path rows."""
+    from cs336_systems_tpu.benchmarks.decode import main
+    from cs336_systems_tpu.models import transformer
+
+    monkeypatch.setitem(transformer.MODEL_SIZES, "tiny", (32, 64, 2, 2))
+    main(["--size", "tiny", "--prompt", "8", "--new", "4", "--reps", "1"])
+    out = capsys.readouterr().out
+    for token in ("kv_cache", "prefill_only", "uncached_loop", "ms_per_token"):
+        assert token in out, f"missing {token!r} in decode benchmark output"
