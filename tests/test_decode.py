@@ -102,3 +102,45 @@ def test_generate_kv_rejects_overflow_and_moe(params):
                     jnp.zeros((1,), jnp.int32), moe_cfg)
     with pytest.raises(ValueError, match="MoE"):
         prefill(params, jnp.zeros((1, 4), jnp.int32), moe_cfg)
+
+
+def test_generate_kv_batched_matches_single_row(params):
+    """Greedy-ish batched decoding must reproduce the single-sequence path
+    row by row (identical prompts, shared key, near-argmax temperature)."""
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    prompt = [1, 2, 3, 4]
+    key = jax.random.PRNGKey(11)
+    kw = dict(max_new_tokens=8, temperature=1e-3, top_k=None)
+    single = generate_kv(params, CFG, prompt, key=key, **kw)
+    batched = generate_kv_batched(
+        params, CFG, jnp.tile(jnp.asarray([prompt], jnp.int32), (3, 1)),
+        key=key, **kw,
+    )
+    assert batched.shape == (3, 8)
+    for row in np.asarray(batched):
+        np.testing.assert_array_equal(row, np.asarray(single))
+
+
+def test_generate_kv_batched_eos_and_validation(params):
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    key = jax.random.PRNGKey(3)
+    full = generate_kv_batched(
+        params, CFG, jnp.asarray([[1, 2, 3]], jnp.int32), 12, key,
+        temperature=0.05, top_k=8,
+    )
+    eos = int(full[0][4])
+    rows = generate_kv_batched(
+        params, CFG, jnp.asarray([[1, 2, 3]], jnp.int32), 12, key,
+        temperature=0.05, top_k=8, eos_token_id=eos,
+    )
+    assert isinstance(rows, list) and len(rows) == 1
+    assert eos not in rows[0]
+
+    with pytest.raises(ValueError, match="batch, prompt_len"):
+        generate_kv_batched(params, CFG, jnp.asarray([1, 2, 3]), 4, key)
+    with pytest.raises(ValueError, match="exceeds context_length"):
+        generate_kv_batched(
+            params, CFG, jnp.zeros((2, 40), jnp.int32), 20, key
+        )
