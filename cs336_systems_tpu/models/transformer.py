@@ -123,15 +123,16 @@ def config_for_size(
     **overrides: Any,
 ) -> TransformerConfig:
     d_model, d_ff, num_layers, num_heads = MODEL_SIZES[name]
-    return TransformerConfig(
+    kwargs: dict[str, Any] = dict(
         vocab_size=vocab_size,
         context_length=context_length,
         d_model=d_model,
         num_layers=num_layers,
         num_heads=num_heads,
         d_ff=d_ff,
-        **overrides,
     )
+    kwargs.update(overrides)  # explicit overrides win over the named size
+    return TransformerConfig(**kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,33 @@ def _chunk(n: int, world: int) -> int:
     return -(-n // world)  # ceil
 
 
+def _ravel_meta(tree):
+    """(total_size, unravel) from a pytree of arrays OR ShapeDtypeStructs.
+
+    The structural twin of ``ravel_pytree`` that never materializes data —
+    callers may pass ``jax.eval_shape`` output as ``params_like`` so a
+    throwaway full-params allocation is never needed just for the layout.
+    ``unravel`` reshapes flat[offset:offset+size] slices back into leaves,
+    casting each to its recorded dtype.
+    """
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [tuple(l.shape) for l in leaves]
+    dtypes = [jnp.dtype(l.dtype) for l in leaves]
+    sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+
+    def unravel(flat):
+        parts = [
+            flat[offsets[i] : offsets[i + 1]].reshape(shapes[i]).astype(dtypes[i])
+            for i in range(len(shapes))
+        ]
+        return jax.tree_util.tree_unflatten(treedef, parts)
+
+    return int(offsets[-1]), unravel
+
+
 def fsdp_init(params, mesh: Mesh, axis: str = "dp"):
     """Build the fully-sharded train state from a (host-replicated) params
     pytree: fp32 master copy + m/v, each [world, chunk] with one row per
@@ -72,10 +99,11 @@ def fsdp_state_bytes(params, world: int) -> int:
 def fsdp_gather_params(state, params_like):
     """Materialize the full (unsharded) params pytree from the sharded
     state — for eval, checkpointing, or comparison against an unsharded
-    run. ``params_like`` supplies the pytree structure and leaf dtypes."""
-    flat, unravel = ravel_pytree(params_like)
-    full = jnp.asarray(state["p"]).reshape(-1)[: flat.shape[0]]
-    return unravel(full.astype(flat.dtype))
+    run. ``params_like`` supplies the pytree structure and leaf dtypes
+    (real arrays or ``jax.eval_shape`` structs — never materialized)."""
+    n, unravel = _ravel_meta(params_like)
+    full = jnp.asarray(state["p"]).reshape(-1)[:n]
+    return unravel(full)
 
 
 def make_fsdp_train_step(
@@ -130,17 +158,16 @@ def _build_fsdp_step(
     params_like,
 ) -> Callable:
     world = mesh.shape[axis]
-    flat_like, unravel = ravel_pytree(params_like)
-    n = flat_like.shape[0]
-    param_dtype = flat_like.dtype
+    n, unravel = _ravel_meta(params_like)
     chunk = _chunk(n, world)
 
     def local_step(state, *batch):
         from cs336_systems_tpu.parallel.dp import local_value_and_grad
 
-        # params: my fp32 chunk -> full flat -> model pytree
+        # params: my fp32 chunk -> full flat -> model pytree (per-leaf
+        # dtype casts happen inside unravel)
         flat = jax.lax.all_gather(state["p"][0], axis, tiled=True)[:n]
-        params = unravel(flat.astype(param_dtype))
+        params = unravel(flat)
 
         loss, grads = local_value_and_grad(loss_fn, axis)(params, *batch)
         loss = jax.lax.pmean(loss, axis)
