@@ -19,8 +19,9 @@ Examples::
     python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel zero1 \
         --steps 5000 --checkpoint-dir ckpt --checkpoint-every 500
 
-    # resume from the last checkpoint
-    python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel zero1 \
+    # resume from the last checkpoint (replicated-optimizer modes: the
+    # sharded modes save params-only checkpoints and cannot resume yet)
+    python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel bucketed \
         --steps 10000 --checkpoint-dir ckpt --resume
 """
 
@@ -208,6 +209,10 @@ def main(argv=None) -> None:
                    help="optimizer steps per dispatch (in-jit loop; "
                         "single-device mode; default 10 on TPU, 1 elsewhere)")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="evaluate held-out loss every N steps (reserves the "
+                        "final 10%% of the corpus as the eval split)")
+    p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true",
@@ -243,6 +248,10 @@ def main(argv=None) -> None:
         cosine_cycle_iters=args.steps,
     )
     corpus = _load_corpus(args)
+    eval_split = None
+    if args.eval_every:
+        cut = max(len(corpus) - max(len(corpus) // 10, args.ctx + 1), 0)
+        corpus, eval_split = corpus[:cut], corpus[cut:]
     # out-of-range ids would be silently CLAMPED by XLA's gather: check a
     # prefix (full scan of a many-GB memmap would stall startup)
     probe = np.asarray(corpus[: 1_000_000])
@@ -280,7 +289,8 @@ def main(argv=None) -> None:
         if args.parallel not in ("none", "naive", "flat", "bucketed"):
             raise SystemExit(
                 "--resume currently supports the replicated-optimizer modes "
-                "(none/naive/flat/bucketed); sharded states re-init"
+                "(none/naive/flat/bucketed) — zero1/fsdp checkpoints are "
+                "params-only and cannot restore the sharded optimizer state"
             )
         if ck["opt_state"] is None:
             raise SystemExit(
@@ -314,6 +324,22 @@ def main(argv=None) -> None:
         sample_key = jax.random.fold_in(
             jax.random.PRNGKey(args.seed), start_step
         )
+
+    eval_fn = None
+    if args.eval_every:
+        from cs336_systems_tpu.train import make_eval_step
+
+        _eval_step = make_eval_step(cfg)
+        eval_rng = np.random.default_rng(args.seed + 1)
+        eval_pairs = [
+            get_batch(eval_split, args.batch, args.ctx, rng=eval_rng)
+            for _ in range(args.eval_batches)
+        ]
+
+        def eval_fn(state):
+            params = to_params(state)
+            losses = [float(_eval_step(params, x, y)) for x, y in eval_pairs]
+            return sum(losses) / len(losses)
 
     def save(step_no):
         params = to_params(state)
@@ -357,6 +383,11 @@ def main(argv=None) -> None:
                 f"step {step_i:6d}  loss {loss_val:7.4f}  "
                 f"{tokens_done / dt:9.0f} tok/s"
             )
+        if eval_fn is not None and (
+            prev // args.eval_every != step_i // args.eval_every
+            or step_i >= args.steps
+        ):
+            print(f"step {step_i:6d}  eval_loss {eval_fn(state):7.4f}")
         if (
             args.checkpoint_dir
             and args.checkpoint_every

@@ -16,7 +16,11 @@ TINY = [
 
 
 def _last_loss(out: str) -> float:
-    lines = [l for l in out.splitlines() if l.startswith("step")]
+    """Last TRAINING loss — eval lines ('step N  eval_loss X') excluded."""
+    lines = [
+        l for l in out.splitlines()
+        if l.startswith("step") and "eval_loss" not in l
+    ]
     assert lines, out
     return float(lines[-1].split("loss")[1].split()[0])
 
@@ -109,3 +113,12 @@ def test_cli_resume_params_only_checkpoint_errors(tmp_path):
     save_checkpoint(ck, params, config=cfg, step=4)  # no opt_state
     with pytest.raises(SystemExit, match="opt_state"):
         main(TINY + ["--steps", "8", "--checkpoint-dir", ck, "--resume"])
+
+
+def test_cli_eval_split(capsys):
+    """--eval-every reports held-out loss on a reserved corpus split."""
+    main(TINY + ["--steps", "4", "--eval-every", "2"])
+    out = capsys.readouterr().out
+    evals = [l for l in out.splitlines() if "eval_loss" in l]
+    assert len(evals) >= 2, out
+    assert all(float(l.split("eval_loss")[1]) < 10 for l in evals)
