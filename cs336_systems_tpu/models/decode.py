@@ -85,15 +85,34 @@ def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
     attn = _cached_attention(q, kc, vc, pos)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
     x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-    x = x + swiglu(bp["ffn"], rmsnorm(bp["ln2"], x), cfg.cdtype)
+    x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
     return x, kc, vc
+
+
+def _ffn(ffn_params, x, cfg: TransformerConfig):
+    """Dense SwiGLU or MoE, matching the training block's dispatch
+    (transformer._block). At inference the MoE aux loss is discarded.
+
+    Note on MoE capacity: routing capacity is computed over the tokens in
+    the CALL (moe.moe_capacity) — a decode step routes B tokens while the
+    uncached full forward routes B·S, so capacity-dropped tokens can
+    differ between the paths when any expert overflows; the two agree
+    exactly only when NO tokens drop on either path (sufficiently large
+    capacity_factor for the routing skew — the default 1.25 is not a
+    guarantee), which the oracle test pins on a generous-capacity config."""
+    if cfg.num_experts > 0:
+        from cs336_systems_tpu.models.moe import moe_ffn
+
+        out, _aux = moe_ffn(
+            ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype
+        )
+        return out
+    return swiglu(ffn_params, x, cfg.cdtype)
 
 
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig):
     """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
     → (logits [B, vocab] fp32, updated cache)."""
-    if cfg.num_experts > 0:
-        raise ValueError("KV-cache decode does not support MoE blocks yet")
     pos = jnp.asarray(pos, jnp.int32)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
     x = embedding(params["token_embeddings"], token_ids[:, None], cfg.cdtype)
@@ -123,8 +142,6 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
 
     prompt_ids: [B, P] (P <= context window). Returns (last-token logits
     [B, vocab] fp32, cache, next position P)."""
-    if cfg.num_experts > 0:
-        raise ValueError("KV-cache decode does not support MoE blocks yet")
     b, plen = prompt_ids.shape
     cache = init_kv_cache(cfg, b, max_len)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
@@ -148,7 +165,7 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
         attn = attention_with_lse(q, k, v, mask)[0]
         attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * dh)
         x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-        x = x + swiglu(bp["ffn"], rmsnorm(bp["ln2"], x), cfg.cdtype)
+        x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
@@ -233,9 +250,6 @@ def generate_kv(
             f"exceeds context_length={cfg.context_length}; use generate() "
             "for sliding-window decoding"
         )
-    if cfg.num_experts > 0:
-        raise ValueError("KV-cache decode does not support MoE blocks yet")
-    # (decode_step/prefill re-check this for direct callers)
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k
     )[0]
@@ -274,8 +288,6 @@ def generate_kv_batched(
             f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds context_length={cfg.context_length}"
         )
-    if cfg.num_experts > 0:
-        raise ValueError("KV-cache decode does not support MoE blocks yet")
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k
     )

@@ -252,6 +252,12 @@ def main(argv=None) -> None:
     if args.eval_every:
         cut = max(len(corpus) - max(len(corpus) // 10, args.ctx + 1), 0)
         corpus, eval_split = corpus[:cut], corpus[cut:]
+        if len(corpus) < args.ctx + 1:
+            raise SystemExit(
+                f"corpus too small to reserve an eval split: {cut} training "
+                f"tokens left but --ctx {args.ctx} needs ctx+1; use a bigger "
+                "corpus or drop --eval-every"
+            )
     # out-of-range ids would be silently CLAMPED by XLA's gather: check a
     # prefix (full scan of a many-GB memmap would stall startup)
     probe = np.asarray(corpus[: 1_000_000])
@@ -338,8 +344,10 @@ def main(argv=None) -> None:
 
         def eval_fn(state):
             params = to_params(state)
-            losses = [float(_eval_step(params, x, y)) for x, y in eval_pairs]
-            return sum(losses) / len(losses)
+            # dispatch every batch first, fetch once: per-batch float()
+            # would serialize eval_batches host round-trips (CLAUDE.md)
+            losses = [_eval_step(params, x, y) for x, y in eval_pairs]
+            return float(np.mean(jax.device_get(losses)))
 
     def save(step_no):
         params = to_params(state)

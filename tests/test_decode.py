@@ -90,18 +90,25 @@ def test_generate_kv_eos_truncation(params):
     assert eos not in np.asarray(trunc)
 
 
-def test_generate_kv_rejects_overflow_and_moe(params):
+def test_generate_kv_rejects_overflow(params):
     with pytest.raises(ValueError, match="exceeds context_length"):
         generate_kv(params, CFG, list(range(40)), 20, jax.random.PRNGKey(0))
-    moe_cfg = dataclasses.replace(CFG, num_experts=4)
-    with pytest.raises(ValueError, match="MoE"):
-        generate_kv(params, moe_cfg, [1], 2, jax.random.PRNGKey(0))
-    # the guard is on the primitives too, not just the wrapper
-    with pytest.raises(ValueError, match="MoE"):
-        decode_step(params, init_kv_cache(CFG, 1), 0,
-                    jnp.zeros((1,), jnp.int32), moe_cfg)
-    with pytest.raises(ValueError, match="MoE"):
-        prefill(params, jnp.zeros((1, 4), jnp.int32), moe_cfg)
+
+
+def test_generate_kv_moe_matches_uncached():
+    """KV-cached decoding of an MoE model reproduces the uncached generate
+    (greedy, generous expert capacity so no tokens drop on either path)."""
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    moe_cfg = dataclasses.replace(
+        CFG, num_experts=4, moe_top_k=2, moe_capacity_factor=8.0
+    )
+    moe_params = init_transformer_lm(jax.random.PRNGKey(5), moe_cfg)
+    kw = dict(max_new_tokens=8, temperature=1e-3, top_k=None)
+    key = jax.random.PRNGKey(7)
+    want = generate(moe_params, moe_cfg, [1, 2, 3], key=key, **kw)
+    got = generate_kv(moe_params, moe_cfg, [1, 2, 3], key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_generate_kv_batched_matches_single_row(params):
