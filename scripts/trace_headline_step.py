@@ -1,0 +1,63 @@
+"""Trace the headline train step on the current backend and print the
+device-time breakdown.
+
+This packages the measurement recipe CLAUDE.md mandates for this runtime
+(host wall-clocks are dispatch-bound; trust device-lane durations): run the
+10-step in-jit loop once for compile, trace a second run, and summarize the
+leaf-op totals via ``utils.profiling.summarize_trace``.
+
+Usage: PYTHONPATH=. python scripts/trace_headline_step.py [logdir]
+"""
+
+import sys
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.train import init_train_state, make_train_loop
+from cs336_systems_tpu.utils.profiling import summarize_trace, trace
+
+
+def main() -> None:
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/headline_trace"
+    on_tpu = jax.default_backend() == "tpu"
+    steps = 10 if on_tpu else 2
+    batch = 32 if on_tpu else 2
+    cfg = config_for_size(
+        "small",
+        context_length=512,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        attn_impl="flash" if on_tpu else "xla",
+        scan_layers=not on_tpu,
+    )
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))
+    xs = jax.random.randint(
+        jax.random.PRNGKey(1), (steps, batch, 512), 0, cfg.vocab_size
+    )
+    ys = jnp.roll(xs, -1, axis=-1)
+
+    params, opt, losses = loop(params, opt, xs, ys)  # compile + warm
+    float(losses[-1])
+    with trace(logdir):
+        params, opt, losses = loop(params, opt, xs, ys)
+        float(losses[-1])
+
+    rows, total = summarize_trace(logdir)
+    print(f"trace: {logdir}   leaf device time {total / steps:.1f} ms/step")
+    print(f"{'op':32s} {'ms/step':>9s} {'count':>7s} {'mean_us':>9s}")
+    for r in rows:
+        print(
+            f"{r['op'][:32]:32s} {r['total_ms'] / steps:9.3f} "
+            f"{r['count']:7d} {r['mean_us']:9.1f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -194,3 +194,24 @@ def test_decode_benchmark_cli_smoke(capsys, monkeypatch):
     out = capsys.readouterr().out
     for token in ("kv_cache", "prefill_only", "uncached_loop", "ms_per_token"):
         assert token in out, f"missing {token!r} in decode benchmark output"
+
+
+def test_summarize_trace(tmp_path):
+    """The trace summarizer reads back real profiler output and reports
+    leaf-op totals (CPU-backend lanes accepted when no device lanes exist)."""
+    from cs336_systems_tpu.utils.profiling import summarize_trace, trace
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    logdir = tmp_path / "t"
+    with trace(str(logdir)):
+        jax.block_until_ready(f(jnp.ones((256, 256))))
+    rows, total = summarize_trace(str(logdir))
+    assert rows and all(
+        {"op", "total_ms", "count", "mean_us"} <= set(r) for r in rows
+    )
+    assert total >= sum(r["total_ms"] for r in rows) - 1e-6
+    # host python stack-frame lanes must not pollute the op rows
+    assert not any(r["op"].startswith("$") for r in rows), rows[:5]
