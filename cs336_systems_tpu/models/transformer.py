@@ -60,6 +60,10 @@ class TransformerConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref" | "flash_xla" | "ring"
+    # causal sliding-window attention: each query attends its last
+    # `attn_window` positions (None = full causal). On the Pallas paths the
+    # kernel grids are banded — cost scales with window, not context.
+    attn_window: int | None = None
     remat: bool = False  # rematerialise each block in backward
     scan_layers: bool = True  # lax.scan over blocks vs unrolled python loop
     sp_axis: str | None = None  # mesh axis of the sequence shard ("ring" only)
@@ -79,6 +83,14 @@ class TransformerConfig:
             raise ValueError(f"unknown attn_impl: {self.attn_impl!r}")
         if self.attn_impl == "ring" and not self.sp_axis:
             raise ValueError("attn_impl='ring' requires sp_axis")
+        if self.attn_window is not None:
+            if self.attn_window < 1:
+                raise ValueError(f"attn_window must be >= 1, got {self.attn_window}")
+            if self.attn_impl == "ring":
+                raise ValueError(
+                    "attn_window is not supported with attn_impl='ring' "
+                    "(the ring schedule streams all K/V shards)"
+                )
         if self.num_experts > 0 and self.moe_top_k > self.num_experts:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} > num_experts={self.num_experts}"
@@ -190,7 +202,12 @@ def count_params(params, non_embedding: bool = True) -> int:
 def _attention(q, k, v, cfg: TransformerConfig):
     """Dispatch the attention inner op. q/k/v: [B, H, S, Dh]."""
     if cfg.attn_impl == "xla":
-        mask = causal_mask(q.shape[-2], k.shape[-2])
+        if cfg.attn_window is not None:
+            from cs336_systems_tpu.ops.attention import banded_causal_mask
+
+            mask = banded_causal_mask(q.shape[-2], k.shape[-2], cfg.attn_window)
+        else:
+            mask = causal_mask(q.shape[-2], k.shape[-2])
         out, _ = attention_with_lse(q, k, v, mask)
         return out
     elif cfg.attn_impl in ("flash", "flash_ref", "flash_xla"):
@@ -201,7 +218,10 @@ def _attention(q, k, v, cfg: TransformerConfig):
         ]
         b, h, s, dh = q.shape
         fold = lambda x: x.reshape(b * h, s, dh)
-        out = flash_attention(fold(q), fold(k), fold(v), causal=True, impl=impl)
+        out = flash_attention(
+            fold(q), fold(k), fold(v), causal=True, impl=impl,
+            window=cfg.attn_window,
+        )
         return out.reshape(b, h, s, dh)
     elif cfg.attn_impl == "ring":
         # sequence-parallel exact attention: must be called inside a

@@ -68,3 +68,11 @@ def causal_mask(n_q: int, n_k: int) -> jax.Array:
     qi = jnp.arange(n_q)[:, None]
     kj = jnp.arange(n_k)[None, :]
     return qi >= kj
+
+
+def banded_causal_mask(n_q: int, n_k: int, window: int) -> jax.Array:
+    """Causal sliding-window mask: query i attends keys in
+    (i - window, i] — the last ``window`` positions including itself."""
+    qi = jnp.arange(n_q)[:, None]
+    kj = jnp.arange(n_k)[None, :]
+    return (qi >= kj) & (qi - kj < window)

@@ -83,7 +83,8 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 # Portable reference forward: lax.scan over K/V tiles (online softmax)
 
 
-def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int):
+def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
+                         window: int | None = None):
     """Tiled online-softmax forward. q/k/v: [B, S, D] → (O [B,S,D], L [B,S]).
 
     The scan body is the same per-tile update as the reference inner loop
@@ -122,6 +123,10 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int):
             valid = kpos_blk[None, :] < n_k  # mask K padding
             if causal:
                 valid = valid & (qpos_blk[:, None] >= kpos_blk[None, :])
+            if window is not None:
+                valid = valid & (
+                    qpos_blk[:, None] - kpos_blk[None, :] < window
+                )
             s = jnp.where(valid[None, :, :], s, _NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             alpha = jnp.exp(m - m_new)
@@ -156,7 +161,8 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, n_k: int, bq: int, bk: int,
-                  n_k_tiles: int):
+                  n_k_tiles: int, window: int | None = None,
+                  banded: bool = False):
     """One (bh-group, q-tile, k-tile) grid step of the online-softmax forward.
 
     The k axis is the innermost grid dimension; Mosaic runs grid steps
@@ -180,10 +186,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     q_start = qi * bq
-    k_start = kj * bk
-
-    # Causal: a k tile strictly right of the q tile's last row is all-masked.
-    needed = (k_start <= q_start + bq - 1) if causal else True
+    if banded:
+        # Sliding-window band: inner index kj walks the n_k_tiles tiles
+        # ending at the diagonal; the TRUE k-tile can be negative at the
+        # top edge (BlockSpec clamps the fetch to tile 0; the mask below
+        # zeroes the whole contribution so nothing is double-counted).
+        k_tile_true = qi - (n_k_tiles - 1) + kj
+        k_start = k_tile_true * bk
+        needed = k_tile_true >= 0
+    else:
+        k_start = kj * bk
+        # Causal: a k tile strictly right of the q tile's last row is
+        # all-masked.
+        needed = (k_start <= q_start + bq - 1) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -198,9 +213,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         )  # [G, bq, bk]
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = kpos < n_k  # K-padding mask
+        if banded:
+            valid = valid & (kpos >= 0)  # clamped top-edge fetches
         if causal:
             qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             valid = valid & (qpos >= kpos)
+            if window is not None:
+                valid = valid & (qpos - kpos < window)
         s = jnp.where(valid[None], s, _NEG_INF)
 
         m_prev = m_ref[:, :, 0:1]  # [G, bq, 1]
@@ -264,8 +283,16 @@ def _gate_group(g: int, n_tiles: int, max_tiles: int) -> int:
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
-                      interpret: bool | None = None):
-    """Host launch of the Pallas forward. q/k/v: [B, S, D] → (O, L)."""
+                      interpret: bool | None = None,
+                      window: int | None = None):
+    """Host launch of the Pallas forward. q/k/v: [B, S, D] → (O, L).
+
+    ``window`` (causal sliding window, in tokens) switches to a BANDED
+    grid: the k axis walks only the ``ceil((window-1)/bk) + 1`` tiles
+    ending at each q-tile's diagonal instead of all tk tiles — the skipped
+    tiles never pay grid-step time OR their K/V block DMAs (unlike
+    ``pl.when`` masking, which fetches everything). At S=65,536 with a
+    4,096 window and 512-tiles that is 9 of 128 k-steps per q-tile."""
     in_dtype = q.dtype
     b, n_q, d = q.shape
     n_k = k.shape[1]
@@ -277,7 +304,17 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
     vp = _pad_to(v, 1, bk)
     sq, sk = qp.shape[1], kp.shape[1]
     tq, tk = sq // bq, sk // bk
-    g = _gate_group(_pick_group(b, bq, bk, d, qp.dtype.itemsize), tk, 16)
+    banded = (
+        window is not None and causal and bq == bk and tq == tk
+        and (max(window, 1) - 1) // bk + 2 < tk
+    )
+    if banded:
+        n_kt = (max(window, 1) - 1) // bk + 2
+        k_index = lambda bi, qi, kj: (bi, jnp.maximum(qi - (n_kt - 1) + kj, 0), 0)
+    else:
+        n_kt = tk
+        k_index = lambda bi, qi, kj: (bi, kj, 0)
+    g = _gate_group(_pick_group(b, bq, bk, d, qp.dtype.itemsize), n_kt, 16)
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -289,15 +326,17 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
         n_k=n_k,
         bq=bq,
         bk=bk,
-        n_k_tiles=tk,
+        n_k_tiles=n_kt,
+        window=window,
+        banded=banded,
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=(b // g, tq, tk),
+        grid=(b // g, tq, n_kt),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
-            pl.BlockSpec((g, bk, d), lambda bi, qi, kj: (bi, kj, 0)),
-            pl.BlockSpec((g, bk, d), lambda bi, qi, kj: (bi, kj, 0)),
+            pl.BlockSpec((g, bk, d), k_index),
+            pl.BlockSpec((g, bk, d), k_index),
         ],
         out_specs=[
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
@@ -333,7 +372,7 @@ _BWD_PALLAS_MAX_S_F32 = 512
 
 
 def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
-                    q_off, k_off):
+                    q_off, k_off, window: int | None = None):
     """Shared recompute core of every Pallas backward kernel: scaled QKᵀ,
     causal mask at global offsets, P = exp(S − L), dP = dO·Vᵀ,
     dS = P ∘ (dP − D) · scale. Returns (p fp32, ds in q.dtype)."""
@@ -344,7 +383,10 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
         n_q, n_k = s.shape
         qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
         kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        keep = (qpos >= kpos) & (kpos >= 0)
+        if window is not None:
+            keep = keep & (qpos - kpos < window)
+        s = jnp.where(keep, s, _NEG_INF)
     p = jnp.exp(s - lse)  # fp32; masked entries exp(-inf - lse) = 0
     dp = jax.lax.dot_general(
         do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
@@ -355,7 +397,8 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
 
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale: float, causal: bool):
+                      dq_ref, dk_ref, dv_ref, *, scale: float, causal: bool,
+                      window: int | None = None):
     q = q_ref[0]
     k = k_ref[0]
     o = o_ref[0].astype(jnp.float32)
@@ -364,7 +407,8 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     delta = jnp.sum(o * do, axis=-1, keepdims=True)  # D: [S, 1]
 
     p, ds = _recompute_p_ds(q, k, v_ref[0], do, lse, delta,
-                            scale=scale, causal=causal, q_off=0, k_off=0)
+                            scale=scale, causal=causal, q_off=0, k_off=0,
+                            window=window)
     dv = jax.lax.dot_general(
         p.astype(v_ref.dtype), do.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -381,14 +425,16 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool,
-                      interpret: bool | None = None):
+                      interpret: bool | None = None,
+                      window: int | None = None):
     """Fused backward: grid (batch·head,), whole sequence per step."""
     b, n_q, d = q.shape
     n_k = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(
-        _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal
+        _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
+        window=window,
     )
     seq_spec = lambda s_len: pl.BlockSpec((1, s_len, d), lambda bi: (bi, 0, 0))
     dq, dk, dv = pl.pallas_call(
@@ -415,7 +461,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool,
 
 
 def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
-                            causal: bool, q_off, k_off):
+                            causal: bool, q_off, k_off,
+                            window: int | None = None, n_q_total=None):
     """Grouped recompute core: operands carry a leading G (batch-row) dim;
     dots are batched over it (Mosaic requires batch dims at position 0).
     Same math as ``_recompute_p_ds``. Returns (p fp32, ds in q.dtype),
@@ -427,7 +474,12 @@ def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
         n_q, n_k = s.shape[1], s.shape[2]
         qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
         kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
-        s = jnp.where((qpos >= kpos)[None], s, _NEG_INF)
+        keep = (qpos >= kpos) & (kpos >= 0)
+        if n_q_total is not None:
+            keep = keep & (qpos < n_q_total)  # clamped bottom-edge q fetches
+        if window is not None:
+            keep = keep & (qpos - kpos < window)
+        s = jnp.where(keep[None], s, _NEG_INF)
     p = jnp.exp(s - lse)  # fp32; masked entries exp(-inf - lse) = 0
     dp = jax.lax.dot_general(
         do.astype(v.dtype), v, (((2,), (2,)), ((0,), (0,))),
@@ -440,7 +492,8 @@ def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, causal: bool, bq: int, bk: int,
-                    n_q_tiles: int):
+                    n_q_tiles: int, window: int | None = None,
+                    banded: bool = False, n_q: int | None = None):
     """Pass 1 of the tiled backward: grid (bh-group, k-tile, q-tile), q
     innermost. VMEM scratch accumulates dK/dV for the current k-tiles across
     q-tiles; all tensors carry a leading G dim (see ``_flash_kernel`` — the
@@ -453,8 +506,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q-tiles strictly left of the k-tile see none of its keys
-    needed = (qi * bq + bq - 1 >= kj * bk) if causal else True
+    if banded:
+        # a k-tile only receives gradient from q-tiles in [kj, kj + n_w);
+        # the TRUE q-tile can run past the end at the bottom edge (fetch
+        # clamped, contribution masked to zero via n_q)
+        q_tile_true = kj + qi
+        q_start = q_tile_true * bq
+        needed = q_start < (n_q if n_q is not None else q_start + 1)
+    else:
+        q_start = qi * bq
+        # causal: q-tiles strictly left of the k-tile see none of its keys
+        needed = (q_start + bq - 1 >= kj * bk) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -462,7 +524,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[:].astype(jnp.float32)
         p, ds = _recompute_p_ds_grouped(
             q, k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
-            scale=scale, causal=causal, q_off=qi * bq, k_off=kj * bk,
+            scale=scale, causal=causal, q_off=q_start, k_off=kj * bk,
+            window=window, n_q_total=n_q,
         )
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(v_ref.dtype), do.astype(v_ref.dtype),
@@ -482,7 +545,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc,
                    *, scale: float, causal: bool, bq: int, bk: int,
-                   n_k_tiles: int):
+                   n_k_tiles: int, window: int | None = None,
+                   banded: bool = False):
     """Pass 2: grid (bh-group, q-tile, k-tile), k innermost; accumulates dQ."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -491,14 +555,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = (kj * bk <= qi * bq + bq - 1) if causal else True
+    if banded:
+        k_tile_true = qi - (n_k_tiles - 1) + kj
+        k_start = k_tile_true * bk
+        needed = k_tile_true >= 0
+    else:
+        k_start = kj * bk
+        needed = (k_start <= qi * bq + bq - 1) if causal else True
 
     @pl.when(needed)
     def _compute():
         do = do_ref[:].astype(jnp.float32)
         _, ds = _recompute_p_ds_grouped(
             q_ref[:], k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
-            scale=scale, causal=causal, q_off=qi * bq, k_off=kj * bk,
+            scale=scale, causal=causal, q_off=qi * bq, k_off=k_start,
+            window=window,
         )
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k_ref[:], (((2,), (1,)), ((0,), (0,))),
@@ -529,7 +600,8 @@ def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int) -> in
 
 def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
                             q_tile: int = 512, k_tile: int = 512,
-                            interpret: bool | None = None):
+                            interpret: bool | None = None,
+                            window: int | None = None):
     """Tiled two-pass backward for long sequences: O(S) memory — no S×S
     tensor ever leaves VMEM. Recomputes P per tile from the saved
     logsumexp (the FlashAttention-2 backward schedule: a dK/dV pass over
@@ -550,19 +622,35 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
 
     common = dict(interpret=interpret)
     scale = 1.0 / math.sqrt(d)
-    g = _gate_group(_pick_group_tiled_bwd(b, bq, bk, d, q.dtype.itemsize), max(tq, tk), 8)
+    banded = (
+        window is not None and causal and bq == bk and tq == tk
+        and (max(window, 1) - 1) // bk + 2 < tk
+    )
+    n_w = (max(window, 1) - 1) // bk + 2 if banded else None
+    n_qt = n_w if banded else tq
+    n_kt_dq = n_w if banded else tk
+    g = _gate_group(
+        _pick_group_tiled_bwd(b, bq, bk, d, q.dtype.itemsize),
+        max(n_qt, n_kt_dq), 8,
+    )
+    if banded:
+        # dkv pass walks q-tiles [kj, kj + n_w), clamped at the bottom edge
+        q_index = lambda bi, kj, qi: (bi, jnp.minimum(kj + qi, tq - 1), 0)
+    else:
+        q_index = lambda bi, kj, qi: (bi, qi, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_q_tiles=tq),
-        grid=(b // g, tk, tq),
+                          bq=bq, bk=bk, n_q_tiles=n_qt, window=window,
+                          banded=banded, n_q=n_q),
+        grid=(b // g, tk, n_qt),
         in_specs=[
-            pl.BlockSpec((g, bq, d), lambda bi, kj, qi: (bi, qi, 0)),   # q
+            pl.BlockSpec((g, bq, d), q_index),                          # q
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # k
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # v
-            pl.BlockSpec((g, bq, d), lambda bi, kj, qi: (bi, qi, 0)),   # do
-            pl.BlockSpec((g, bq, 1), lambda bi, kj, qi: (bi, qi, 0)),   # lse
-            pl.BlockSpec((g, bq, 1), lambda bi, kj, qi: (bi, qi, 0)),   # delta
+            pl.BlockSpec((g, bq, d), q_index),                          # do
+            pl.BlockSpec((g, bq, 1), q_index),                          # lse
+            pl.BlockSpec((g, bq, 1), q_index),                          # delta
         ],
         out_specs=[
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
@@ -579,14 +667,19 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
         **common,
     )(q, k, v, do, lse_c, delta_c)
 
+    if banded:
+        k_index = lambda bi, qi, kj: (bi, jnp.maximum(qi - (n_w - 1) + kj, 0), 0)
+    else:
+        k_index = lambda bi, qi, kj: (bi, kj, 0)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_k_tiles=tk),
-        grid=(b // g, tq, tk),
+                          bq=bq, bk=bk, n_k_tiles=n_kt_dq, window=window,
+                          banded=banded),
+        grid=(b // g, tq, n_kt_dq),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # q
-            pl.BlockSpec((g, bk, d), lambda bi, qi, kj: (bi, kj, 0)),   # k
-            pl.BlockSpec((g, bk, d), lambda bi, qi, kj: (bi, kj, 0)),   # v
+            pl.BlockSpec((g, bk, d), k_index),                          # k
+            pl.BlockSpec((g, bk, d), k_index),                          # v
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # do
             pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # lse
             pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # delta
@@ -603,7 +696,8 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
 # Backward: recompute from the saved logsumexp (XLA-fused)
 
 
-def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool):
+def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool,
+                         window: int | None = None):
     """Recompute-P backward (reference backward_pass_recomp,
     flash_attention.py:270-287), one fused XLA computation.
 
@@ -616,7 +710,11 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool):
     s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
         n_q, n_k = q.shape[1], k.shape[1]
-        mask = jnp.arange(n_q)[:, None] >= jnp.arange(n_k)[None, :]
+        qi = jnp.arange(n_q)[:, None]
+        kj = jnp.arange(n_k)[None, :]
+        mask = qi >= kj
+        if window is not None:
+            mask = mask & (qi - kj < window)
         s = jnp.where(mask[None], s, _NEG_INF)
     p = jnp.exp(s - lse[..., None])  # [b, nq, nk] fp32
     dof = do.astype(jnp.float32)
@@ -636,7 +734,7 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool):
 # Public API with custom VJP
 
 
-def _flash_fwd_xla(q, k, v, causal: bool):
+def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None):
     """Un-tiled fused forward for short sequences: one XLA einsum chain.
 
     Materializes the [B, n_q, n_k] score matrix *inside* the jit (fused, never
@@ -644,31 +742,42 @@ def _flash_fwd_xla(q, k, v, causal: bool):
     memory contract matches the tiled kernels). At S ≲ 1-2k this beats the
     Pallas grid on TPU; the tiled paths take over where S×S no longer fits.
     """
-    from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
+    from cs336_systems_tpu.ops.attention import (
+        attention_with_lse,
+        banded_causal_mask,
+        causal_mask,
+    )
 
-    mask = causal_mask(q.shape[1], k.shape[1]) if causal else None
+    if causal and window is not None:
+        mask = banded_causal_mask(q.shape[1], k.shape[1], window)
+    elif causal:
+        mask = causal_mask(q.shape[1], k.shape[1])
+    else:
+        mask = None
     return attention_with_lse(q, k, v, mask)
 
 
-def _flash_forward(q, k, v, causal, impl, q_tile, k_tile):
+def _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window=None):
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
     if impl == "pallas":
-        return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile)
+        return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile, window=window)
     elif impl == "reference":
-        return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile)
+        return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile, window=window)
     elif impl == "xla":
-        return _flash_fwd_xla(q, k, v, causal)
+        return _flash_fwd_xla(q, k, v, causal, window=window)
     raise ValueError(f"unknown flash impl: {impl!r}")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, impl, q_tile, k_tile):
-    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, impl, q_tile, k_tile, window):
+    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window)
 
 
-def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile):
-    o, lse = _flash_forward(q, k, v, causal, impl, q_tile, k_tile)
+def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile, window):
+    o, lse = _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window)
     # Residuals mirror the reference contract: exactly (Q, K, V, O, L) with
     # L = logsumexp of shape [batch, n_queries] (flash_attention.py:66-70).
     return (o, lse), (q, k, v, o, lse)
@@ -708,33 +817,37 @@ def _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile) -> bool:
     return n_q % bq == 0 and n_k % bk == 0
 
 
-def _flash_bwd_rule(causal, impl, q_tile, k_tile, res, cotangents):
+def _flash_bwd_rule(causal, impl, q_tile, k_tile, window, res, cotangents):
     q, k, v, o, lse = res
     # LSE is a saved softmax statistic, not a differentiable output (parity:
     # the reference backward receives only dO); its cotangent is discarded.
     do, _ = cotangents
     if _eligible_for_pallas_bwd(q, k, impl):
         # single fused kernel: whole sequence per grid step, least recompute
-        return _flash_bwd_pallas(q, k, v, o, lse, do, causal)
+        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, window=window)
     if _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile):
-        # two-pass tiled kernels: any length, O(S) memory
+        # two-pass tiled kernels: any length, O(S) memory (banded when
+        # windowed — see _flash_fwd_pallas)
         return _flash_bwd_pallas_tiled(
-            q, k, v, o, lse, do, causal, q_tile=q_tile, k_tile=k_tile
+            q, k, v, o, lse, do, causal, q_tile=q_tile, k_tile=k_tile,
+            window=window,
         )
-    return _flash_bwd_recompute(q, k, v, o, lse, do, causal)
+    return _flash_bwd_recompute(q, k, v, o, lse, do, causal, window=window)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _folded_call(q, k, v, causal, impl, q_tile, k_tile):
+def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None):
     """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash."""
     squeeze = q.ndim == 2
     if squeeze:
         q, k, v = q[None], k[None], v[None]
     lead = q.shape[:-2]
     fold = lambda x: x.reshape((-1,) + x.shape[-2:])
-    o, lse = _flash(fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile)
+    o, lse = _flash(
+        fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile, window
+    )
     o = o.reshape(lead + o.shape[-2:])
     lse = lse.reshape(lead + lse.shape[-1:])
     if squeeze:
@@ -750,6 +863,7 @@ def flash_attention(
     impl: str = "auto",
     q_tile: int = DEFAULT_Q_TILE,
     k_tile: int = DEFAULT_K_TILE,
+    window: int | None = None,
 ) -> jax.Array:
     """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
 
@@ -758,8 +872,13 @@ def flash_attention(
     short S, same LSE-only residual contract), or "auto" (pallas on TPU else
     reference). Leading batch dims are folded; 2-D inputs get a singleton
     batch like the reference host side (flash_attention.py:92-99).
+
+    ``window``: causal sliding-window attention — query i attends keys in
+    (i-window, i]. On the Pallas paths the fwd and tiled-bwd grids are
+    BANDED: out-of-window tiles are never visited (no grid-step time, no
+    K/V DMA), so cost scales with window, not sequence length.
     """
-    return _folded_call(q, k, v, causal, impl, q_tile, k_tile)[0]
+    return _folded_call(q, k, v, causal, impl, q_tile, k_tile, window)[0]
 
 
 def flash_attention_with_lse(
@@ -770,10 +889,11 @@ def flash_attention_with_lse(
     impl: str = "auto",
     q_tile: int = DEFAULT_Q_TILE,
     k_tile: int = DEFAULT_K_TILE,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning (O, logsumexp [..., n_q] fp32) — the saved-residual
     contract (reference test digs L out of saved_tensors, test_attention.py:
     48-51). Differentiable in O through the same backward dispatch as
     ``flash_attention`` (fused Pallas kernel on TPU for eligible shapes,
     XLA recompute otherwise); accepts the same [..., S, D] shapes."""
-    return _folded_call(q, k, v, causal, impl, q_tile, k_tile)
+    return _folded_call(q, k, v, causal, impl, q_tile, k_tile, window)

@@ -264,3 +264,43 @@ def test_checkpoint_roundtrip(tmp_path):
         np.asarray(transformer_lm(ck["params"], x, cfg2)),
         rtol=1e-6,
     )
+
+
+def test_lm_attn_window_locality():
+    """With attn_window=W, a token's logits must be invariant to input
+    changes more than W positions back (and sensitive within the window)."""
+    import dataclasses
+
+    from cs336_systems_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer_lm,
+        transformer_lm,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32, context_length=64, d_model=32, num_layers=1,
+        num_heads=2, d_ff=64, attn_impl="flash_ref", attn_window=8,
+    )
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 32, (1, 64)), jnp.int32)
+    base = transformer_lm(params, ids, cfg)
+
+    # single-layer window=8: position 40 sees inputs 33..40 only
+    far = ids.at[0, 10].set((int(ids[0, 10]) + 1) % 32)
+    near = ids.at[0, 38].set((int(ids[0, 38]) + 1) % 32)
+    out_far = transformer_lm(params, far, cfg)
+    out_near = transformer_lm(params, near, cfg)
+    np.testing.assert_allclose(
+        np.asarray(out_far[0, 40]), np.asarray(base[0, 40]), atol=1e-5
+    )
+    assert float(jnp.max(jnp.abs(out_near[0, 40] - base[0, 40]))) > 1e-4
+
+    # config validation
+    with pytest.raises(ValueError, match="attn_window"):
+        dataclasses.replace(cfg, attn_window=0)
+    with pytest.raises(ValueError, match="ring"):
+        TransformerConfig(
+            vocab_size=32, context_length=64, d_model=32, num_layers=1,
+            num_heads=2, d_ff=64, attn_impl="ring", sp_axis="sp",
+            attn_window=8,
+        )
