@@ -54,16 +54,23 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None
     }
 
 
-def _cached_attention(q, k_cache, v_cache, pos):
+def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None):
     """q: [B,H,1,Dh]; caches [B,H,S,Dh]; attend to positions <= pos.
 
     Delegates to the shared masked-softmax op (ops/attention.py) — the mask
-    [1, S] selects the filled cache prefix."""
+    [1, S] selects the filled cache prefix. With ``window`` set (sliding-
+    window attention, transformer.TransformerConfig.attn_window) the mask
+    additionally requires ``pos - j < window``, matching
+    ``ops.attention.banded_causal_mask`` row ``pos`` so cached decoding
+    agrees with the uncached ``generate`` numerics."""
     from cs336_systems_tpu.ops.attention import attention_with_lse
 
     s = k_cache.shape[-2]
-    mask = (jnp.arange(s) <= pos)[None, :]
-    return attention_with_lse(q, k_cache, v_cache, mask)[0]
+    idx = jnp.arange(s)
+    mask = idx <= pos
+    if window is not None:
+        mask &= pos - idx < window
+    return attention_with_lse(q, k_cache, v_cache, mask[None, :])[0]
 
 
 def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
@@ -82,7 +89,7 @@ def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
 
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
-    attn = _cached_attention(q, kc, vc, pos)
+    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
     x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
     x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
@@ -148,10 +155,17 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     positions = jnp.arange(plen)
     h, dh = cfg.num_heads, cfg.d_head
 
-    from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
+    from cs336_systems_tpu.ops.attention import (
+        attention_with_lse,
+        banded_causal_mask,
+        causal_mask,
+    )
 
     x = embedding(params["token_embeddings"], prompt_ids, cfg.cdtype)
-    mask = causal_mask(plen, plen)
+    if cfg.attn_window is not None:
+        mask = banded_causal_mask(plen, plen, cfg.attn_window)
+    else:
+        mask = causal_mask(plen, plen)
 
     def body(carry, bp):
         x = carry
@@ -235,6 +249,13 @@ def generate_kv(
     Note: prompt + max_new_tokens must fit the context window (the cache is
     the window); the uncached ``generate`` additionally supports sliding-
     window truncation for longer generations.
+
+    MoE caveat: expert routing capacity is computed per CALL (decode routes
+    B tokens/step, the uncached forward routes B·S at once), so when any
+    expert overflows its capacity the dropped-token sets — and therefore
+    the outputs — can differ between this path and ``generate``. The paths
+    agree exactly only when no tokens drop on either (raise
+    ``cfg.moe_capacity_factor`` if that matters); see ``_ffn``.
     """
     ids = jnp.asarray(prompt_ids, jnp.int32)
     if ids.ndim != 1:

@@ -216,6 +216,15 @@ def make_sampled_train_loop(
         donate_argnums=(0, 1) if donate else (),
     )
     def loop(params, opt_state, corpus, key, batch_size):
+        # shape is static under jit, so this raises at trace time; without
+        # it randint gets an empty/inverted [0, N - ctx) range and
+        # dynamic_slice clamps — silently training on garbage crops.
+        if corpus.shape[0] < ctx + 1:
+            raise ValueError(
+                f"corpus has {corpus.shape[0]} tokens but context_length="
+                f"{ctx} needs at least {ctx + 1} to cut one (x, y) crop"
+            )
+
         def one_step(carry, _):
             params, opt_state, key = carry
             key, sub = jax.random.split(key)

@@ -79,6 +79,28 @@ def test_generate_kv_matches_uncached_generate(params):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_generate_kv_windowed_matches_uncached():
+    """Sliding-window attention (cfg.attn_window) must survive the KV-cache
+    rearrangement: prefill uses the banded mask and each decode step drops
+    keys older than the window, matching the uncached generate exactly.
+    Window of 4 over a 6-token prompt + 10 new tokens guarantees every step
+    past the fourth actually excludes history (the regression this pins:
+    decode used to attend the full cache)."""
+    win_cfg = dataclasses.replace(CFG, attn_window=4)
+    win_params = init_transformer_lm(jax.random.PRNGKey(4), win_cfg)
+    prompt = [1, 2, 3, 4, 5, 6]
+    kw = dict(max_new_tokens=10, temperature=1e-3, top_k=None)
+    key = jax.random.PRNGKey(9)
+    want = generate(win_params, win_cfg, prompt, key=key, **kw)
+    got = generate_kv(win_params, win_cfg, prompt, key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # and the window genuinely changes the distribution vs full causal
+    full = generate_kv(win_params, dataclasses.replace(win_cfg, attn_window=None),
+                       prompt, key=key, **kw)
+    assert not np.array_equal(np.asarray(got), np.asarray(full))
+
+
 def test_generate_kv_eos_truncation(params):
     prompt = [1, 2, 3]
     key = jax.random.PRNGKey(3)

@@ -25,12 +25,28 @@ def _last_loss(out: str) -> float:
     return float(lines[-1].split("loss")[1].split()[0])
 
 
-@pytest.mark.parametrize("mode", ["none", "bucketed", "zero1", "fsdp"])
+_MODE_NONE_LOSS: dict[str, float] = {}
+
+
+def _reference_loss(capsys) -> float:
+    """Loss of --parallel none, computed once per session — the other modes
+    are compared to it rather than to a hard-coded constant (which any
+    jax/XLA RNG change would break even with all modes still agreeing)."""
+    if "loss" not in _MODE_NONE_LOSS:
+        main(TINY + ["--steps", "4", "--parallel", "none"])
+        _MODE_NONE_LOSS["loss"] = _last_loss(capsys.readouterr().out)
+    return _MODE_NONE_LOSS["loss"]
+
+
+@pytest.mark.parametrize("mode", ["bucketed", "zero1", "fsdp"])
 def test_cli_parallel_modes_agree(mode, capsys):
+    ref = _reference_loss(capsys)
     main(TINY + ["--steps", "4", "--parallel", mode])
     loss = _last_loss(capsys.readouterr().out)
     # same seed, same data, same update semantics in every mode
-    np.testing.assert_allclose(loss, 4.6083, atol=2e-3)
+    np.testing.assert_allclose(loss, ref, atol=2e-3)
+    # and the run is actually training (not NaN/degenerate)
+    assert 0 < ref < 10
 
 
 def test_cli_checkpoint_resume(tmp_path, capsys):
