@@ -60,6 +60,15 @@ class TransformerConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     attn_impl: str = "xla"  # "xla" | "flash" | "flash_ref" | "flash_xla" | "ring"
+    # Mesh axis names the attention operands' batch / head dims are sharded
+    # over (activations [B, H, S, Dh]). When set — and a mesh is passed to
+    # the apply fns — the flash attention call runs inside a shard_map over
+    # those axes: a pallas_call is an opaque custom call that GSPMD cannot
+    # partition (it would gather the operands), so under GSPMD-sharded
+    # steps (tensor/expert parallel) the kernel must be given its local
+    # block explicitly. The "xla" impl needs neither.
+    attn_batch_shard: str | None = None
+    attn_head_shard: str | None = None
     # causal sliding-window attention: each query attends its last
     # `attn_window` positions (None = full causal). On the Pallas paths the
     # kernel grids are banded — cost scales with window, not context.
@@ -86,11 +95,6 @@ class TransformerConfig:
         if self.attn_window is not None:
             if self.attn_window < 1:
                 raise ValueError(f"attn_window must be >= 1, got {self.attn_window}")
-            if self.attn_impl == "ring":
-                raise ValueError(
-                    "attn_window is not supported with attn_impl='ring' "
-                    "(the ring schedule streams all K/V shards)"
-                )
         if self.num_experts > 0 and self.moe_top_k > self.num_experts:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} > num_experts={self.num_experts}"
@@ -199,8 +203,13 @@ def count_params(params, non_embedding: bool = True) -> int:
 # Apply
 
 
-def _attention(q, k, v, cfg: TransformerConfig):
-    """Dispatch the attention inner op. q/k/v: [B, H, S, Dh]."""
+def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
+    """Dispatch the attention inner op. q/k/v: [B, H, S, Dh].
+
+    ``mesh`` (a ``jax.sharding.Mesh``): required only when
+    ``cfg.attn_batch_shard`` / ``cfg.attn_head_shard`` declare the operands
+    sharded — the flash kernel then runs in a ``shard_map`` over those axes
+    with its local [B/dp, H/tp, S, Dh] block (see the config fields)."""
     if cfg.attn_impl == "xla":
         if cfg.attn_window is not None:
             from cs336_systems_tpu.ops.attention import banded_causal_mask
@@ -216,27 +225,51 @@ def _attention(q, k, v, cfg: TransformerConfig):
         impl = {"flash": "pallas", "flash_ref": "reference", "flash_xla": "xla"}[
             cfg.attn_impl
         ]
-        b, h, s, dh = q.shape
-        fold = lambda x: x.reshape(b * h, s, dh)
-        out = flash_attention(
-            fold(q), fold(k), fold(v), causal=True, impl=impl,
-            window=cfg.attn_window,
-        )
-        return out.reshape(b, h, s, dh)
+
+        def local_attn(q, k, v):
+            b, h, s, dh = q.shape
+            fold = lambda x: x.reshape(b * h, s, dh)
+            out = flash_attention(
+                fold(q), fold(k), fold(v), causal=True, impl=impl,
+                window=cfg.attn_window,
+            )
+            return out.reshape(b, h, s, dh)
+
+        if cfg.attn_batch_shard or cfg.attn_head_shard:
+            if mesh is None:
+                raise ValueError(
+                    "cfg declares attention sharding "
+                    f"(batch={cfg.attn_batch_shard!r}, "
+                    f"head={cfg.attn_head_shard!r}) but no mesh was passed "
+                    "to the apply fn"
+                )
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(cfg.attn_batch_shard, cfg.attn_head_shard)
+            return jax.shard_map(
+                local_attn, mesh=mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+            )(q, k, v)
+        return local_attn(q, k, v)
     elif cfg.attn_impl == "ring":
         # sequence-parallel exact attention: must be called inside a
         # shard_map whose mesh has cfg.sp_axis; q/k/v here hold the LOCAL
-        # sequence shard, and positions carry the global offsets.
+        # sequence shard, and positions carry the global offsets. The
+        # per-hop inner op is the flash kernel (window → truncated ring).
         from cs336_systems_tpu.parallel.ring import ring_attention
 
         b, h, s, dh = q.shape
         fold = lambda x: x.reshape(b * h, s, dh)
-        out = ring_attention(fold(q), fold(k), fold(v), axis=cfg.sp_axis, causal=True)
+        out = ring_attention(
+            fold(q), fold(k), fold(v), axis=cfg.sp_axis, causal=True,
+            window=cfg.attn_window,
+        )
         return out.reshape(b, h, s, dh)
     raise ValueError(f"unknown attn_impl: {cfg.attn_impl}")
 
 
-def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig):
+def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig,
+         mesh=None):
     """Causal multi-head self-attention with RoPE on Q and K.
 
     Parity: CausalMultiHeadSelfAttention (model.py:435-524).
@@ -259,13 +292,14 @@ def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig):
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
     with jax.named_scope("sdpa"):
-        out = _attention(q, k, v, cfg)
+        out = _attention(q, k, v, cfg, mesh)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
     with jax.named_scope("out_proj"):
         return linear(p["output_proj"], out, cfg.cdtype)
 
 
-def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig):
+def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig,
+           mesh=None):
     """Pre-norm block: x + attn(ln1 x); then x + ffn(ln2 x).
 
     Returns ``(x, aux)`` — ``aux`` is the MoE load-balance loss for this
@@ -273,7 +307,7 @@ def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig):
     metadata and profiler traces — the NVTX-range parity (reference
     transformer_annotated.py:35-98)."""
     with jax.named_scope("attn"):
-        x = x + _mha(block_params["attn"], rmsnorm(block_params["ln1"], x), cos, sin, positions, cfg)
+        x = x + _mha(block_params["attn"], rmsnorm(block_params["ln1"], x), cos, sin, positions, cfg, mesh)
     with jax.named_scope("ffn"):
         h = rmsnorm(block_params["ln2"], x)
         if cfg.num_experts > 0:
@@ -295,6 +329,7 @@ def transformer_lm_with_aux(
     token_ids: jax.Array,
     cfg: TransformerConfig,
     positions: jax.Array | None = None,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward pass: [B, S] int ids → ([B, S, vocab] logits, aux scalar).
 
@@ -303,6 +338,9 @@ def transformer_lm_with_aux(
     (``cfg.scan_layers``) or as an unrolled loop; with ``cfg.remat`` each
     block is wrapped in ``jax.checkpoint`` so the backward pass recomputes
     activations instead of storing S×L of them (HBM trade).
+
+    ``mesh``: required when cfg declares attention-operand sharding
+    (``attn_batch_shard``/``attn_head_shard`` — see ``_attention``).
     """
     if token_ids.ndim == 1:
         token_ids = token_ids[None, :]
@@ -314,12 +352,15 @@ def transformer_lm_with_aux(
     with jax.named_scope("embed"):
         x = embedding(params["token_embeddings"], token_ids, cfg.cdtype)
 
+    def blk_fn(bp, x):
+        return _block(bp, x, cos, sin, positions, cfg, mesh)
+
     aux = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         # One compiled block body for any depth; backward stashes activations
         # into stacked [L, ...] buffers via dynamic-update-slice.
         def body(carry, bp):
-            return _block(bp, carry, cos, sin, positions, cfg)
+            return blk_fn(bp, carry)
 
         if cfg.remat:
             body = jax.checkpoint(body, prevent_cse=False)
@@ -330,16 +371,16 @@ def transformer_lm_with_aux(
         # Unrolled: more HLO and compile time, but the backward reads each
         # layer's activations where they were produced — no stash copies.
         # ~20% faster per step than scan at small depth (measured on v5e).
-        blk = _block
+        blk = blk_fn
         if cfg.remat:
             # prevent_cse must stay True here: outside lax.scan XLA CSE would
             # merge the forward and recomputed activations, silently undoing
             # the rematerialization.
-            blk = jax.checkpoint(blk, static_argnums=(5,))
+            blk = jax.checkpoint(blk_fn)
         with jax.named_scope("blocks"):
             for i in range(cfg.num_layers):
                 bp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
-                x, aux_i = blk(bp, x, cos, sin, positions, cfg)
+                x, aux_i = blk(bp, x)
                 aux = aux + aux_i
 
     with jax.named_scope("final_norm"):
@@ -353,13 +394,14 @@ def transformer_lm(
     token_ids: jax.Array,
     cfg: TransformerConfig,
     positions: jax.Array | None = None,
+    mesh=None,
 ) -> jax.Array:
     """Forward pass: [B, S] int ids → [B, S, vocab] logits (compute dtype).
 
     See ``transformer_lm_with_aux`` for the (logits, MoE aux loss) variant;
     this drops the aux term (exactly zero for dense configs).
     """
-    return transformer_lm_with_aux(params, token_ids, cfg, positions)[0]
+    return transformer_lm_with_aux(params, token_ids, cfg, positions, mesh)[0]
 
 
 # ---------------------------------------------------------------------------

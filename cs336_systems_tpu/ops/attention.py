@@ -63,16 +63,20 @@ def attention_with_lse(
     return out.astype(in_dtype), lse
 
 
-def causal_mask(n_q: int, n_k: int) -> jax.Array:
-    """Boolean [n_q, n_k] causal mask (True = attend), query i sees keys <= i."""
-    qi = jnp.arange(n_q)[:, None]
+def causal_mask(n_q: int, n_k: int, q_off: int = 0) -> jax.Array:
+    """Boolean [n_q, n_k] causal mask (True = attend), query i sees keys
+    <= i. ``q_off`` shifts the queries' global positions right of the keys
+    (sequence-parallel ring hops attend earlier K/V shards)."""
+    qi = q_off + jnp.arange(n_q)[:, None]
     kj = jnp.arange(n_k)[None, :]
     return qi >= kj
 
 
-def banded_causal_mask(n_q: int, n_k: int, window: int) -> jax.Array:
+def banded_causal_mask(n_q: int, n_k: int, window: int,
+                       q_off: int = 0) -> jax.Array:
     """Causal sliding-window mask: query i attends keys in
-    (i - window, i] — the last ``window`` positions including itself."""
-    qi = jnp.arange(n_q)[:, None]
+    (i - window, i] — the last ``window`` positions including itself.
+    ``q_off`` as in ``causal_mask``."""
+    qi = q_off + jnp.arange(n_q)[:, None]
     kj = jnp.arange(n_k)[None, :]
     return (qi >= kj) & (qi - kj < window)

@@ -69,6 +69,15 @@ def _pick_tile(n: int, want: int) -> int:
     return t
 
 
+def _out_sds(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
+    """Pallas out_shape that inherits the operands' varying manual axes —
+    required when a kernel runs inside a ``shard_map`` with the vma check on
+    (ring hops, the TP-sharded attention region); a plain ShapeDtypeStruct
+    carries ``vma=None`` and is rejected there."""
+    vma = frozenset().union(*(jax.typeof(o).vma for o in operands))
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     n = x.shape[axis]
     pad = (-n) % multiple
@@ -84,12 +93,16 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
-                         window: int | None = None):
+                         window: int | None = None, q_off: int = 0):
     """Tiled online-softmax forward. q/k/v: [B, S, D] → (O [B,S,D], L [B,S]).
 
     The scan body is the same per-tile update as the reference inner loop
     (flash_attention.py:44-63): running max m, running denominator l,
     rescale-accumulate O; epilogue O/l and L = m + log l.
+
+    ``q_off``: static global offset of query row 0 relative to key row 0 —
+    ring/sequence-parallel hops attend a K/V block that sits ``q_off``
+    positions behind the local queries (parallel/ring.py).
     """
     in_dtype = q.dtype
     b, n_q, d = q.shape
@@ -107,7 +120,7 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
     kf = kp.reshape(b, tk, bk, d)
     vf = vp.reshape(b, tk, bk, d)
 
-    q_pos = jnp.arange(tq * bq).reshape(tq, bq)  # global query positions
+    q_pos = q_off + jnp.arange(tq * bq).reshape(tq, bq)  # global query positions
     k_pos = jnp.arange(tk * bk).reshape(tk, bk)  # global key positions
 
     def q_block(q_blk, qpos_blk):
@@ -138,9 +151,12 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
             )
             return (m_new, l, acc), None
 
-        m0 = jnp.full((b, bq), _NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, bq), jnp.float32)
-        a0 = jnp.zeros((b, bq, d), jnp.float32)
+        # init derived from q_blk (not fresh constants) so it inherits q's
+        # varying manual axes — fresh zeros are axis-INVARIANT and fail the
+        # scan-carry check when this runs inside a shard_map (ring hops).
+        a0 = q_blk.astype(jnp.float32) * 0.0
+        l0 = a0[..., 0]
+        m0 = l0 + _NEG_INF
         (m, l, acc), _ = jax.lax.scan(
             kv_step, (m0, l0, a0), (kf.swapaxes(0, 1), vf.swapaxes(0, 1), k_pos)
         )
@@ -162,7 +178,7 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, n_k: int, bq: int, bk: int,
                   n_k_tiles: int, window: int | None = None,
-                  banded: bool = False):
+                  banded: bool = False, q_off: int = 0):
     """One (bh-group, q-tile, k-tile) grid step of the online-softmax forward.
 
     The k axis is the innermost grid dimension; Mosaic runs grid steps
@@ -188,17 +204,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     q_start = qi * bq
     if banded:
         # Sliding-window band: inner index kj walks the n_k_tiles tiles
-        # ending at the diagonal; the TRUE k-tile can be negative at the
-        # top edge (BlockSpec clamps the fetch to tile 0; the mask below
-        # zeroes the whole contribution so nothing is double-counted).
-        k_tile_true = qi - (n_k_tiles - 1) + kj
+        # ending at the (q_off-shifted) diagonal; the TRUE k-tile can fall
+        # outside [0, tk) at the edges (BlockSpec clamps the fetch; the
+        # mask below zeroes the whole contribution so nothing is
+        # double-counted).
+        k_tile_true = qi + q_off // bk - (n_k_tiles - 1) + kj
         k_start = k_tile_true * bk
-        needed = k_tile_true >= 0
+        needed = (k_tile_true >= 0) & (k_start < n_k)
     else:
         k_start = kj * bk
         # Causal: a k tile strictly right of the q tile's last row is
         # all-masked.
-        needed = (k_start <= q_start + bq - 1) if causal else True
+        needed = (k_start <= q_start + q_off + bq - 1) if causal else True
+        if causal and window is not None:
+            # tiles wholly left of the window contribute nothing
+            needed = needed & (q_start + q_off - (k_start + bk - 1) < window)
 
     @pl.when(needed)
     def _compute():
@@ -216,7 +236,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         if banded:
             valid = valid & (kpos >= 0)  # clamped top-edge fetches
         if causal:
-            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            qpos = q_start + q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
             valid = valid & (qpos >= kpos)
             if window is not None:
                 valid = valid & (qpos - kpos < window)
@@ -284,7 +306,7 @@ def _gate_group(g: int, n_tiles: int, max_tiles: int) -> int:
 
 def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
                       interpret: bool | None = None,
-                      window: int | None = None):
+                      window: int | None = None, q_off: int = 0):
     """Host launch of the Pallas forward. q/k/v: [B, S, D] → (O, L).
 
     ``window`` (causal sliding window, in tokens) switches to a BANDED
@@ -292,7 +314,11 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
     ending at each q-tile's diagonal instead of all tk tiles — the skipped
     tiles never pay grid-step time OR their K/V block DMAs (unlike
     ``pl.when`` masking, which fetches everything). At S=65,536 with a
-    4,096 window and 512-tiles that is 9 of 128 k-steps per q-tile."""
+    4,096 window and 512-tiles that is 9 of 128 k-steps per q-tile.
+
+    ``q_off`` shifts the queries' global positions right of the keys'
+    (ring hops); the banded grid follows the shifted diagonal when the
+    offset is tile-aligned, else masking alone enforces the band."""
     in_dtype = q.dtype
     b, n_q, d = q.shape
     n_k = k.shape[1]
@@ -306,11 +332,15 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
     tq, tk = sq // bq, sk // bk
     banded = (
         window is not None and causal and bq == bk and tq == tk
+        and q_off % bk == 0
         and (max(window, 1) - 1) // bk + 2 < tk
     )
     if banded:
         n_kt = (max(window, 1) - 1) // bk + 2
-        k_index = lambda bi, qi, kj: (bi, jnp.maximum(qi - (n_kt - 1) + kj, 0), 0)
+        off_t = q_off // bk
+        k_index = lambda bi, qi, kj: (
+            bi, jnp.clip(qi + off_t - (n_kt - 1) + kj, 0, tk - 1), 0
+        )
     else:
         n_kt = tk
         k_index = lambda bi, qi, kj: (bi, kj, 0)
@@ -329,6 +359,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
         n_k_tiles=n_kt,
         window=window,
         banded=banded,
+        q_off=q_off,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -343,8 +374,8 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
             pl.BlockSpec((g, bq, 128), lambda bi, qi, kj: (bi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, sq, d), in_dtype),
-            jax.ShapeDtypeStruct((b, sq, 128), jnp.float32),
+            _out_sds((b, sq, d), in_dtype, qp, kp, vp),
+            _out_sds((b, sq, 128), jnp.float32, qp, kp, vp),
         ],
         scratch_shapes=[
             pltpu.VMEM((g, bq, 128), jnp.float32),  # running max m
@@ -396,18 +427,31 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
     return p, ds
 
 
-def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale: float, causal: bool,
-                      window: int | None = None):
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
+                      scale: float, causal: bool,
+                      window: int | None = None, q_off: int = 0,
+                      has_dlse: bool = False):
+    if has_dlse:
+        dlse_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        dq_ref, dk_ref, dv_ref = rest
+        dlse_ref = None
     q = q_ref[0]
     k = k_ref[0]
     o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [S, 1] column (host passes lse[..., None])
-    delta = jnp.sum(o * do, axis=-1, keepdims=True)  # D: [S, 1]
+    # D' = rowsum(O ∘ dO) − dL: the lse cotangent folds into delta because
+    # ∂L/∂S = P — so dS gains +P·dL, i.e. delta -= dlse. The dlse operand
+    # exists only when the caller actually differentiates through the lse
+    # (symbolic_zeros in _flash_bwd_rule) — the common O-only training path
+    # keeps the original operand set and its measured throughput.
+    delta = jnp.sum(o * do, axis=-1, keepdims=True)
+    if dlse_ref is not None:
+        delta = delta - dlse_ref[0]
 
     p, ds = _recompute_p_ds(q, k, v_ref[0], do, lse, delta,
-                            scale=scale, causal=causal, q_off=0, k_off=0,
+                            scale=scale, causal=causal, q_off=q_off, k_off=0,
                             window=window)
     dv = jax.lax.dot_general(
         p.astype(v_ref.dtype), do.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
@@ -424,39 +468,47 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool,
+def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
                       interpret: bool | None = None,
-                      window: int | None = None):
-    """Fused backward: grid (batch·head,), whole sequence per step."""
+                      window: int | None = None, q_off: int = 0):
+    """Fused backward: grid (batch·head,), whole sequence per step.
+
+    ``dlse`` (the lse cotangent) may be None — the O-only differentiation
+    path — in which case the kernel runs with the original operand set
+    (no extra column DMA)."""
     b, n_q, d = q.shape
     n_k = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(
         _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
-        window=window,
+        window=window, q_off=q_off, has_dlse=dlse is not None,
     )
     seq_spec = lambda s_len: pl.BlockSpec((1, s_len, d), lambda bi: (bi, 0, 0))
+    # lse/dlse as [B, S, 1] columns: the minor block dim equals the full
+    # array dim (Mosaic-legal), they land in VMEM already sublane-major —
+    # no 128× broadcast materialization, no in-kernel relayout.
+    col_spec = pl.BlockSpec((1, n_q, 1), lambda bi: (bi, 0, 0))
+    in_specs = [
+        seq_spec(n_q), seq_spec(n_k), seq_spec(n_k), seq_spec(n_q),
+        col_spec, seq_spec(n_q),
+    ]
+    operands = [q, k, v, o, lse[..., None], do]
+    if dlse is not None:
+        in_specs.append(col_spec)
+        operands.append(dlse[..., None])
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(b,),
-        in_specs=[
-            seq_spec(n_q), seq_spec(n_k), seq_spec(n_k), seq_spec(n_q),
-            # lse as a [B, S, 1] column: the minor block dim equals the full
-            # array dim (Mosaic-legal), it lands in VMEM already sublane-
-            # major — no 128× broadcast materialization, no in-kernel
-            # relayout.
-            pl.BlockSpec((1, n_q, 1), lambda bi: (bi, 0, 0)),
-            seq_spec(n_q),
-        ],
+        in_specs=in_specs,
         out_specs=[seq_spec(n_q), seq_spec(n_k), seq_spec(n_k)],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _out_sds(q.shape, q.dtype, q, k, v, do),
+            _out_sds(k.shape, k.dtype, q, k, v, do),
+            _out_sds(v.shape, v.dtype, q, k, v, do),
         ],
         interpret=interpret,
-    )(q, k, v, o, lse[..., None], do)
+    )(*operands)
     return dq, dk, dv
 
 
@@ -493,7 +545,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, causal: bool, bq: int, bk: int,
                     n_q_tiles: int, window: int | None = None,
-                    banded: bool = False, n_q: int | None = None):
+                    banded: bool = False, n_q: int | None = None,
+                    q_off: int = 0):
     """Pass 1 of the tiled backward: grid (bh-group, k-tile, q-tile), q
     innermost. VMEM scratch accumulates dK/dV for the current k-tiles across
     q-tiles; all tensors carry a leading G dim (see ``_flash_kernel`` — the
@@ -507,16 +560,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     if banded:
-        # a k-tile only receives gradient from q-tiles in [kj, kj + n_w);
-        # the TRUE q-tile can run past the end at the bottom edge (fetch
-        # clamped, contribution masked to zero via n_q)
-        q_tile_true = kj + qi
+        # a k-tile only receives gradient from q-tiles in
+        # [kj - q_off/bk, kj - q_off/bk + n_w); the TRUE q-tile can run
+        # past either end at the edges (fetch clamped, contribution masked
+        # to zero via n_q / q_tile_true >= 0)
+        q_tile_true = kj - q_off // bq + qi
         q_start = q_tile_true * bq
-        needed = q_start < (n_q if n_q is not None else q_start + 1)
+        needed = (q_tile_true >= 0) & (
+            q_start < (n_q if n_q is not None else q_start + 1)
+        )
     else:
         q_start = qi * bq
         # causal: q-tiles strictly left of the k-tile see none of its keys
-        needed = (q_start + bq - 1 >= kj * bk) if causal else True
+        needed = (q_start + q_off + bq - 1 >= kj * bk) if causal else True
 
     @pl.when(needed)
     def _compute():
@@ -524,8 +580,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[:].astype(jnp.float32)
         p, ds = _recompute_p_ds_grouped(
             q, k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
-            scale=scale, causal=causal, q_off=q_start, k_off=kj * bk,
-            window=window, n_q_total=n_q,
+            scale=scale, causal=causal, q_off=q_start + q_off, k_off=kj * bk,
+            window=window, n_q_total=(n_q + q_off) if n_q is not None else None,
         )
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(v_ref.dtype), do.astype(v_ref.dtype),
@@ -546,7 +602,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc,
                    *, scale: float, causal: bool, bq: int, bk: int,
                    n_k_tiles: int, window: int | None = None,
-                   banded: bool = False):
+                   banded: bool = False, n_k: int | None = None,
+                   q_off: int = 0):
     """Pass 2: grid (bh-group, q-tile, k-tile), k innermost; accumulates dQ."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -556,19 +613,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     if banded:
-        k_tile_true = qi - (n_k_tiles - 1) + kj
+        k_tile_true = qi + q_off // bk - (n_k_tiles - 1) + kj
         k_start = k_tile_true * bk
         needed = k_tile_true >= 0
+        if n_k is not None:
+            needed = needed & (k_start < n_k)
     else:
         k_start = kj * bk
-        needed = (k_start <= qi * bq + bq - 1) if causal else True
+        needed = (k_start <= qi * bq + q_off + bq - 1) if causal else True
+        if causal and window is not None:
+            needed = needed & (qi * bq + q_off - (k_start + bk - 1) < window)
 
     @pl.when(needed)
     def _compute():
         do = do_ref[:].astype(jnp.float32)
         _, ds = _recompute_p_ds_grouped(
             q_ref[:], k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
-            scale=scale, causal=causal, q_off=qi * bq, k_off=k_start,
+            scale=scale, causal=causal, q_off=qi * bq + q_off, k_off=k_start,
             window=window,
         )
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
@@ -598,10 +659,10 @@ def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int) -> in
     return g
 
 
-def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
+def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
                             q_tile: int = 512, k_tile: int = 512,
                             interpret: bool | None = None,
-                            window: int | None = None):
+                            window: int | None = None, q_off: int = 0):
     """Tiled two-pass backward for long sequences: O(S) memory — no S×S
     tensor ever leaves VMEM. Recomputes P per tile from the saved
     logsumexp (the FlashAttention-2 backward schedule: a dK/dV pass over
@@ -615,8 +676,14 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # delta = rowsum(o * do): cheap [B, S] precompute outside the kernels
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    # delta = rowsum(o * do) − dlse: cheap [B, S] precompute outside the
+    # kernels (the lse cotangent folds into delta — see _flash_bwd_rule;
+    # dlse is None on the O-only path)
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )
+    if dlse is not None:
+        delta = delta - dlse
     lse_c = lse[..., None]      # [B, S, 1] column blocks
     delta_c = delta[..., None]
 
@@ -624,25 +691,30 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
     scale = 1.0 / math.sqrt(d)
     banded = (
         window is not None and causal and bq == bk and tq == tk
+        and q_off % bk == 0
         and (max(window, 1) - 1) // bk + 2 < tk
     )
     n_w = (max(window, 1) - 1) // bk + 2 if banded else None
     n_qt = n_w if banded else tq
     n_kt_dq = n_w if banded else tk
+    off_t = q_off // bk if banded else 0
     g = _gate_group(
         _pick_group_tiled_bwd(b, bq, bk, d, q.dtype.itemsize),
         max(n_qt, n_kt_dq), 8,
     )
     if banded:
-        # dkv pass walks q-tiles [kj, kj + n_w), clamped at the bottom edge
-        q_index = lambda bi, kj, qi: (bi, jnp.minimum(kj + qi, tq - 1), 0)
+        # dkv pass walks q-tiles [kj - off_t, kj - off_t + n_w), clamped at
+        # both edges
+        q_index = lambda bi, kj, qi: (
+            bi, jnp.clip(kj - off_t + qi, 0, tq - 1), 0
+        )
     else:
         q_index = lambda bi, kj, qi: (bi, qi, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_q_tiles=n_qt, window=window,
-                          banded=banded, n_q=n_q),
+                          banded=banded, n_q=n_q, q_off=q_off),
         grid=(b // g, tk, n_qt),
         in_specs=[
             pl.BlockSpec((g, bq, d), q_index),                          # q
@@ -657,8 +729,8 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _out_sds(k.shape, k.dtype, q, k, v, do),
+            _out_sds(v.shape, v.dtype, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((g, bk, d), jnp.float32),
@@ -668,13 +740,15 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
     )(q, k, v, do, lse_c, delta_c)
 
     if banded:
-        k_index = lambda bi, qi, kj: (bi, jnp.maximum(qi - (n_w - 1) + kj, 0), 0)
+        k_index = lambda bi, qi, kj: (
+            bi, jnp.clip(qi + off_t - (n_w - 1) + kj, 0, tk - 1), 0
+        )
     else:
         k_index = lambda bi, qi, kj: (bi, kj, 0)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_k_tiles=n_kt_dq, window=window,
-                          banded=banded),
+                          banded=banded, n_k=n_k, q_off=q_off),
         grid=(b // g, tq, n_kt_dq),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # q
@@ -685,7 +759,7 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
             pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # delta
         ],
         out_specs=pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_out_sds(q.shape, q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
         **common,
     )(q, k, v, do, lse_c, delta_c)
@@ -696,29 +770,36 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, causal: bool,
 # Backward: recompute from the saved logsumexp (XLA-fused)
 
 
-def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool,
-                         window: int | None = None):
+def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
+                         window: int | None = None, q_off: int = 0):
     """Recompute-P backward (reference backward_pass_recomp,
     flash_attention.py:270-287), one fused XLA computation.
 
-    P = exp(QKᵀ/√d − L); D = rowsum(O ∘ dO);
+    P = exp(QKᵀ/√d − L); D = rowsum(O ∘ dO) − dL;
     dV = PᵀdO; dP = dO Vᵀ; dS = P ∘ (dP − D); dQ = dS K/√d; dK = dSᵀQ/√d.
+    (The −dL term is the logsumexp output's cotangent: ∂L/∂S = P.)
     """
     in_dtype = q.dtype
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
+        from cs336_systems_tpu.ops.attention import (
+            banded_causal_mask,
+            causal_mask,
+        )
+
         n_q, n_k = q.shape[1], k.shape[1]
-        qi = jnp.arange(n_q)[:, None]
-        kj = jnp.arange(n_k)[None, :]
-        mask = qi >= kj
         if window is not None:
-            mask = mask & (qi - kj < window)
+            mask = banded_causal_mask(n_q, n_k, window, q_off)
+        else:
+            mask = causal_mask(n_q, n_k, q_off)
         s = jnp.where(mask[None], s, _NEG_INF)
     p = jnp.exp(s - lse[..., None])  # [b, nq, nk] fp32
     dof = do.astype(jnp.float32)
     delta = jnp.sum(o.astype(jnp.float32) * dof, axis=-1)  # D: [b, nq]
+    if dlse is not None:
+        delta = delta - dlse
     dv = jnp.einsum("bqk,bqd->bkd", p, dof, preferred_element_type=jnp.float32)
     dp = jnp.einsum("bqd,bkd->bqk", dof, v.astype(jnp.float32),
                     preferred_element_type=jnp.float32)
@@ -734,7 +815,8 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, causal: bool,
 # Public API with custom VJP
 
 
-def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None):
+def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None,
+                   q_off: int = 0):
     """Un-tiled fused forward for short sequences: one XLA einsum chain.
 
     Materializes the [B, n_q, n_k] score matrix *inside* the jit (fused, never
@@ -749,35 +831,45 @@ def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None):
     )
 
     if causal and window is not None:
-        mask = banded_causal_mask(q.shape[1], k.shape[1], window)
+        mask = banded_causal_mask(q.shape[1], k.shape[1], window, q_off)
     elif causal:
-        mask = causal_mask(q.shape[1], k.shape[1])
+        mask = causal_mask(q.shape[1], k.shape[1], q_off)
     else:
         mask = None
     return attention_with_lse(q, k, v, mask)
 
 
-def _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window=None):
+def _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window=None,
+                   q_off=0):
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
+    if q_off and not causal:
+        raise ValueError("q_pos_offset only affects causal masking; it "
+                         "requires causal=True")
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
     if impl == "pallas":
-        return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile, window=window)
+        return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile,
+                                 window=window, q_off=q_off)
     elif impl == "reference":
-        return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile, window=window)
+        return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile,
+                                    window=window, q_off=q_off)
     elif impl == "xla":
-        return _flash_fwd_xla(q, k, v, causal, window=window)
+        return _flash_fwd_xla(q, k, v, causal, window=window, q_off=q_off)
     raise ValueError(f"unknown flash impl: {impl!r}")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, impl, q_tile, k_tile, window):
-    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, impl, q_tile, k_tile, window, q_off):
+    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window, q_off)
 
 
-def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile, window):
-    o, lse = _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window)
+def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile, window, q_off):
+    # symbolic_zeros=True wraps each primal in a CustomVJPPrimal
+    q, k, v = q.value, k.value, v.value
+    o, lse = _flash_forward(
+        q, k, v, causal, impl, q_tile, k_tile, window, q_off
+    )
     # Residuals mirror the reference contract: exactly (Q, K, V, O, L) with
     # L = logsumexp of shape [batch, n_queries] (flash_attention.py:66-70).
     return (o, lse), (q, k, v, o, lse)
@@ -817,28 +909,44 @@ def _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile) -> bool:
     return n_q % bq == 0 and n_k % bk == 0
 
 
-def _flash_bwd_rule(causal, impl, q_tile, k_tile, window, res, cotangents):
+def _flash_bwd_rule(causal, impl, q_tile, k_tile, window, q_off, res,
+                    cotangents):
+    from jax.custom_derivatives import SymbolicZero
+
     q, k, v, o, lse = res
-    # LSE is a saved softmax statistic, not a differentiable output (parity:
-    # the reference backward receives only dO); its cotangent is discarded.
-    do, _ = cotangents
+    # Both outputs are differentiable. The LSE cotangent folds into the
+    # delta term of every backward: ∂L/∂S = P, so dS = P∘(dP − D + dL) —
+    # i.e. D' = D − dL. Callers that use only O produce a SYMBOLIC zero
+    # dlse (defvjp(..., symbolic_zeros=True)) and dispatch the original
+    # reference-parity backward (flash_attention.py:270-287) with no extra
+    # operand — measured ~2% of headline throughput; callers that consume
+    # the LSE (ring attention's online-softmax merge, parallel/ring.py)
+    # get exact gradients through the dlse term.
+    do, dlse = cotangents
+    if isinstance(dlse, SymbolicZero):
+        dlse = None
+    if isinstance(do, SymbolicZero):  # lse-only differentiation
+        do = jnp.zeros(o.shape, o.dtype)
     if _eligible_for_pallas_bwd(q, k, impl):
         # single fused kernel: whole sequence per grid step, least recompute
-        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, window=window)
+        return _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal,
+                                 window=window, q_off=q_off)
     if _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile):
         # two-pass tiled kernels: any length, O(S) memory (banded when
         # windowed — see _flash_fwd_pallas)
         return _flash_bwd_pallas_tiled(
-            q, k, v, o, lse, do, causal, q_tile=q_tile, k_tile=k_tile,
-            window=window,
+            q, k, v, o, lse, do, dlse, causal, q_tile=q_tile, k_tile=k_tile,
+            window=window, q_off=q_off,
         )
-    return _flash_bwd_recompute(q, k, v, o, lse, do, causal, window=window)
+    return _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal,
+                                window=window, q_off=q_off)
 
 
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule, symbolic_zeros=True)
 
 
-def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None):
+def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None,
+                 q_off=0):
     """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash."""
     squeeze = q.ndim == 2
     if squeeze:
@@ -846,7 +954,8 @@ def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None):
     lead = q.shape[:-2]
     fold = lambda x: x.reshape((-1,) + x.shape[-2:])
     o, lse = _flash(
-        fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile, window
+        fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile, window,
+        q_off,
     )
     o = o.reshape(lead + o.shape[-2:])
     lse = lse.reshape(lead + lse.shape[-1:])
@@ -864,6 +973,7 @@ def flash_attention(
     q_tile: int = DEFAULT_Q_TILE,
     k_tile: int = DEFAULT_K_TILE,
     window: int | None = None,
+    q_pos_offset: int = 0,
 ) -> jax.Array:
     """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
 
@@ -877,8 +987,14 @@ def flash_attention(
     (i-window, i]. On the Pallas paths the fwd and tiled-bwd grids are
     BANDED: out-of-window tiles are never visited (no grid-step time, no
     K/V DMA), so cost scales with window, not sequence length.
+
+    ``q_pos_offset``: static global position of query row 0 relative to key
+    row 0 — sequence-parallel ring hops (parallel/ring.py) attend K/V blocks
+    that sit whole shards behind the local queries.
     """
-    return _folded_call(q, k, v, causal, impl, q_tile, k_tile, window)[0]
+    return _folded_call(
+        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset
+    )[0]
 
 
 def flash_attention_with_lse(
@@ -890,10 +1006,14 @@ def flash_attention_with_lse(
     q_tile: int = DEFAULT_Q_TILE,
     k_tile: int = DEFAULT_K_TILE,
     window: int | None = None,
+    q_pos_offset: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning (O, logsumexp [..., n_q] fp32) — the saved-residual
     contract (reference test digs L out of saved_tensors, test_attention.py:
-    48-51). Differentiable in O through the same backward dispatch as
-    ``flash_attention`` (fused Pallas kernel on TPU for eligible shapes,
-    XLA recompute otherwise); accepts the same [..., S, D] shapes."""
-    return _folded_call(q, k, v, causal, impl, q_tile, k_tile, window)
+    48-51). BOTH outputs are differentiable (the lse cotangent folds into
+    the backward's delta term — see ``_flash_bwd_rule``), so downstream
+    online-softmax merges of per-block results (ring attention) autodiff
+    exactly; accepts the same [..., S, D] shapes."""
+    return _folded_call(
+        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset
+    )

@@ -98,18 +98,31 @@ def make_ep_train_step(
     Like TP, gradient averaging over dp and the expert dispatch collectives
     are GSPMD-inserted from the sharding annotations — one jit, no forks.
     """
+    import dataclasses
+
     from cs336_systems_tpu.train import lm_loss, make_update_fn
 
     validate_ep(cfg, mesh, ep_axis)
     pspecs = param_specs(cfg, ep_axis)
     ospecs = opt_state_specs(cfg, ep_axis)
-    bspec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
+    have_dp = dp_axis and dp_axis in mesh.shape
+    bspec = P(dp_axis) if have_dp else P()
     from cs336_systems_tpu.parallel.mesh import named_sharding_tree
 
     sh = functools.partial(named_sharding_tree, mesh)
 
+    if cfg.attn_impl in ("flash", "flash_ref", "flash_xla") and not (
+        cfg.attn_batch_shard or cfg.attn_head_shard
+    ) and have_dp:
+        # same reasoning as make_tp_train_step: GSPMD cannot partition the
+        # Pallas custom call, so pin the attention operands' batch sharding
+        # and run the kernel in a shard_map over dp (heads replicated — EP
+        # shards only the expert FFN weights).
+        cfg = dataclasses.replace(cfg, attn_batch_shard=dp_axis)
+
     step = make_update_fn(
-        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+        functools.partial(lm_loss, cfg=cfg, mesh=mesh), hp, clip_norm,
+        lr_schedule,
     )
 
     return jax.jit(

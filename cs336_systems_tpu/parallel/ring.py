@@ -4,15 +4,34 @@ Long-context capability beyond the reference (which scales sequence length
 on one device only, via FlashAttention tiling — SURVEY §5 "long-context:
 absent as a distribution strategy"): here the sequence axis itself is
 sharded over an ``sp`` mesh axis, and K/V shards rotate around the ICI ring
-(``lax.ppermute``) while each device accumulates online-softmax partials
-for its local queries — attention memory per device stays O(S/W), and each
-K/V hop overlaps with the block-attention compute of the previous hop.
+(``lax.ppermute``) while each device merges per-hop attention partials for
+its local queries.
 
-Same algorithmic skeleton as the FlashAttention forward (running max m,
-denominator l, rescale-accumulate O; epilogue O/l, L = m + log l), with the
-K/V "tile loop" distributed over devices instead of VMEM tiles. Exactness:
-identical math to full attention up to fp accumulation order, tested
-against the dense oracle.
+The per-hop block attention IS the FlashAttention kernel
+(ops/flash_attention.py) — per-hop memory stays O(S_local·D + tile²), never
+[S_local, S_local] in HBM, so the single-device kernel's long-context
+property survives the mesh. Each hop returns (O_block, LSE_block); blocks
+merge by the online-softmax identity
+
+    lse' = logaddexp(lse, lse_b);  o' = o·e^{lse−lse'} + o_b·e^{lse_b−lse'}
+
+which autodiffs exactly because the kernel's logsumexp output is itself
+differentiable (its cotangent folds into the backward's delta term —
+``_flash_bwd_rule``). Hop t's block sits ``t·S_local`` positions behind the
+local queries; the kernel masks at those global offsets via
+``q_pos_offset`` (static per hop, so the banded/causal grid skipping still
+applies).
+
+Causal scheduling: hop t is useful only on devices with index ≥ t (earlier
+blocks); wrapped-around future blocks are killed by weighting them with an
+``lse = −inf`` select — the one data-dependent device-index operation.
+Sliding windows truncate the ring: blocks more than
+``ceil(window/S_local)`` hops back are out of every query's window, so
+those hops are never communicated at all — ring traffic scales with the
+window, not the global sequence.
+
+Exactness: identical math to full attention up to fp accumulation order,
+tested against the dense oracle (tests/test_tp_sp.py).
 
 Call inside ``shard_map`` with q/k/v already sequence-sharded:
 q, k, v: [B, S_local, D] (heads folded into B), global seq = W * S_local.
@@ -20,33 +39,16 @@ q, k, v: [B, S_local, D] (heads folded into B), global seq = W * S_local.
 
 from __future__ import annotations
 
-import functools
-import math
-
 import jax
 import jax.numpy as jnp
 
+from cs336_systems_tpu.ops.flash_attention import (
+    DEFAULT_K_TILE,
+    DEFAULT_Q_TILE,
+    flash_attention_with_lse,
+)
+
 _NEG_INF = -1e30
-
-
-def _block_update(carry, q, k_blk, v_blk, q_pos, k_pos, causal, scale, in_dtype):
-    """One online-softmax accumulation of a K/V block (fp32 state)."""
-    m, l, acc = carry
-    s = (
-        jnp.einsum("bqd,bkd->bqk", q, k_blk, preferred_element_type=jnp.float32)
-        * scale
-    )
-    if causal:
-        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])
-    l = l * alpha + jnp.sum(p, axis=-1)
-    acc = acc * alpha[..., None] + jnp.einsum(
-        "bqk,bkd->bqd", p.astype(in_dtype), v_blk,
-        preferred_element_type=jnp.float32,
-    )
-    return m_new, l, acc
 
 
 def ring_attention_with_lse(
@@ -57,66 +59,87 @@ def ring_attention_with_lse(
     causal: bool = True,
     axis_size: int | None = None,
     remat_steps: bool = True,
+    window: int | None = None,
+    impl: str = "auto",
+    q_tile: int = DEFAULT_Q_TILE,
+    k_tile: int = DEFAULT_K_TILE,
 ):
-    """→ (O [B, S_local, D], L [B, S_local]) for this device's queries.
+    """→ (O [B, S_local, D], L [B, S_local] fp32) for this device's queries.
 
     ``axis_size``: ring size; inferred from the ambient mesh when None.
     ``remat_steps``: recompute each hop's block attention in the backward
-    instead of storing its intermediates (keeps activation memory at
-    O(S_local²-free, one block) while autodiff runs through the ring).
+    instead of storing its intermediates (the hop inputs — one K/V block —
+    are the only per-hop residuals either way).
+    ``window``: causal sliding window in global tokens; hops beyond the
+    window are skipped entirely (no ppermute, no compute).
+    ``impl``: flash impl per hop ("auto" = Pallas kernel on TPU, portable
+    scan tiling elsewhere).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if axis_size is None:
         axis_size = jax.lax.axis_size(axis)
     w = int(axis_size)
     b, s_local, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    in_dtype = q.dtype
     idx = jax.lax.axis_index(axis)
-
-    q_pos = idx * s_local + jnp.arange(s_local)
     perm = [(i, (i + 1) % w) for i in range(w)]  # send my block to the right
 
-    def make_attend(step):
-        def attend(m, l, acc, k_blk, v_blk):
-            # after `step` hops I hold the block originally on device idx-step
-            blk_owner = (idx - step) % w
-            k_pos = blk_owner * s_local + jnp.arange(s_local)
-            return _block_update(
-                (m, l, acc), q, k_blk, v_blk, q_pos, k_pos, causal, scale, in_dtype
+    # Number of hops that can contribute to ANY query: under a window,
+    # blocks more than ceil((window-1)/S_local) hops back are entirely
+    # stale (the earliest in-window key for any query on this shard is
+    # window-1 positions back).
+    hops = w
+    if window is not None:
+        hops = min(w, -(-(max(window, 1) - 1) // s_local) + 1)
+
+    def attend(t, q, kb, vb):
+        if causal:
+            # Hop t's keys sit t whole shards behind the queries: mask at
+            # the static global offset (t = 0 is the local causal diagonal).
+            return flash_attention_with_lse(
+                q, kb, vb, causal=True, impl=impl, q_tile=q_tile,
+                k_tile=k_tile, window=window, q_pos_offset=t * s_local,
             )
-
-        return jax.checkpoint(attend) if remat_steps else attend
-
-    # Fresh fp32 constants would be device-invariant, but the state becomes
-    # axis-varying after the first block — derive the init state from q so
-    # it inherits exactly q's varying axes (sp, and dp when present).
-    acc0 = q.astype(jnp.float32) * 0.0
-    l0 = acc0[..., 0]
-
-    # Hop 0 attends the local block with no communication; each later hop
-    # permutes first, then attends — so exactly w-1 ppermutes total and the
-    # last received block is actually used (no discarded final rotation).
-    m, l, acc = make_attend(0)(l0 + _NEG_INF, l0, acc0, k, v)
-
-    def hop(carry_kv, step):
-        (m, l, acc), (k_blk, v_blk) = carry_kv
-        k_blk = jax.lax.ppermute(k_blk, axis, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis, perm)
-        m, l, acc = make_attend(step)(m, l, acc, k_blk, v_blk)
-        return ((m, l, acc), (k_blk, v_blk)), None
-
-    if w > 1:
-        ((m, l, acc), _), _ = jax.lax.scan(
-            hop, ((m, l, acc), (k, v)), jnp.arange(1, w)
+        return flash_attention_with_lse(
+            q, kb, vb, causal=False, impl=impl, q_tile=q_tile, k_tile=k_tile
         )
 
-    safe_l = jnp.where(l > 0.0, l, 1.0)
-    out = (acc / safe_l[..., None]).astype(in_dtype)
-    lse = m + jnp.log(safe_l)
-    return out, lse
+    # Hop 0 attends the local block with no communication; each later hop
+    # permutes first, then attends — exactly hops-1 ppermutes total.
+    o_acc, lse = attend(0, q, k, v)
+    o_acc = o_acc.astype(jnp.float32)
+
+    kb, vb = k, v
+    for t in range(1, hops):
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+
+        def hop(q, kb, vb, t=t):
+            return attend(t, q, kb, vb)
+
+        if remat_steps:
+            hop = jax.checkpoint(hop)
+        o_b, lse_b = hop(q, kb, vb)
+        if causal:
+            # after t hops I hold the block from device idx - t; devices
+            # with idx < t received a wrapped-around FUTURE block — weight
+            # it out with an lse of -inf (merge weight exp(-inf - x) = 0,
+            # and both cotangents vanish with it).
+            lse_b = jnp.where(idx >= t, lse_b, _NEG_INF)
+        new_lse = jnp.logaddexp(lse, lse_b)
+        o_acc = (
+            o_acc * jnp.exp(lse - new_lse)[..., None]
+            + o_b.astype(jnp.float32) * jnp.exp(lse_b - new_lse)[..., None]
+        )
+        lse = new_lse
+
+    return o_acc.astype(q.dtype), lse
 
 
 def ring_attention(q, k, v, axis: str, causal: bool = True,
-                   axis_size: int | None = None) -> jax.Array:
-    out, _ = ring_attention_with_lse(q, k, v, axis, causal, axis_size)
+                   axis_size: int | None = None,
+                   window: int | None = None, impl: str = "auto") -> jax.Array:
+    out, _ = ring_attention_with_lse(
+        q, k, v, axis, causal, axis_size, window=window, impl=impl
+    )
     return out

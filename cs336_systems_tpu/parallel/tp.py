@@ -108,6 +108,7 @@ def make_tp_train_step(
     backward's gradient collectives overlap with remaining compute — the
     property the reference builds by hand with async NCCL hooks.
     """
+    import dataclasses
     import functools
 
     from cs336_systems_tpu.train import lm_loss, make_update_fn
@@ -115,13 +116,28 @@ def make_tp_train_step(
     validate_tp(cfg, mesh, tp_axis)
     pspecs = param_specs(cfg, tp_axis)
     ospecs = opt_state_specs(cfg, tp_axis)
-    bspec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
+    have_dp = dp_axis and dp_axis in mesh.shape
+    bspec = P(dp_axis) if have_dp else P()
     from cs336_systems_tpu.parallel.mesh import named_sharding_tree
 
     sh = functools.partial(named_sharding_tree, mesh)
 
+    if cfg.attn_impl in ("flash", "flash_ref", "flash_xla") and not (
+        cfg.attn_batch_shard or cfg.attn_head_shard
+    ):
+        # The Pallas kernel is an opaque custom call GSPMD cannot partition;
+        # declare the attention operands' layout (batch over dp, heads over
+        # tp) so _attention runs the kernel in a shard_map with its local
+        # block instead of letting GSPMD gather the operands.
+        cfg = dataclasses.replace(
+            cfg,
+            attn_batch_shard=dp_axis if have_dp else None,
+            attn_head_shard=tp_axis,
+        )
+
     step = make_update_fn(
-        functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule
+        functools.partial(lm_loss, cfg=cfg, mesh=mesh), hp, clip_norm,
+        lr_schedule,
     )
 
     return jax.jit(

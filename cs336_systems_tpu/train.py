@@ -23,8 +23,8 @@ from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
 
 
-def lm_loss(params, x, y, cfg: TransformerConfig):
-    logits, aux = transformer_lm_with_aux(params, x, cfg)
+def lm_loss(params, x, y, cfg: TransformerConfig, mesh=None):
+    logits, aux = transformer_lm_with_aux(params, x, cfg, mesh=mesh)
     loss = cross_entropy(logits, y)
     if cfg.num_experts > 0 and cfg.moe_aux_weight:
         loss = loss + cfg.moe_aux_weight * aux

@@ -100,8 +100,10 @@ def test_pallas_bwd_kernel_matches_recompute(causal):
     q, k, v = _make_qkv(jax.random.PRNGKey(6), 3, 256, 256, 64)
     o_ref, lse = _oracle(q, k, v, causal)
     do = jax.random.normal(jax.random.PRNGKey(7), o_ref.shape, o_ref.dtype)
-    want = _flash_bwd_recompute(q, k, v, o_ref, lse, do, causal)
-    got = _flash_bwd_pallas(q, k, v, o_ref, lse, do, causal, interpret=True)
+    dlse = jnp.zeros(lse.shape, jnp.float32)
+    want = _flash_bwd_recompute(q, k, v, o_ref, lse, do, dlse, causal)
+    got = _flash_bwd_pallas(q, k, v, o_ref, lse, do, dlse, causal,
+                            interpret=True)
     for g, w, name in zip(got, want, "qkv"):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w), rtol=2e-3, atol=2e-3,
@@ -122,9 +124,11 @@ def test_pallas_tiled_bwd_matches_recompute(causal):
     q, k, v = _make_qkv(jax.random.PRNGKey(8), 2, 512, 512, 64)
     o_ref, lse = _oracle(q, k, v, causal)
     do = jax.random.normal(jax.random.PRNGKey(9), o_ref.shape, o_ref.dtype)
-    want = _flash_bwd_recompute(q, k, v, o_ref, lse, do, causal)
+    dlse = jnp.zeros(lse.shape, jnp.float32)
+    want = _flash_bwd_recompute(q, k, v, o_ref, lse, do, dlse, causal)
     got = _flash_bwd_pallas_tiled(
-        q, k, v, o_ref, lse, do, causal, q_tile=128, k_tile=128, interpret=True
+        q, k, v, o_ref, lse, do, dlse, causal, q_tile=128, k_tile=128,
+        interpret=True
     )
     for g, w, name in zip(got, want, "qkv"):
         np.testing.assert_allclose(
@@ -192,6 +196,108 @@ def test_flash_with_lse_4d_and_grad(impl):
         )
     )(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_lse_cotangent_flows_through_backward(impl):
+    """Gradients of a function that CONSUMES the logsumexp (ring attention's
+    online-softmax merge does) must match autodiff through the oracle — the
+    lse cotangent folds into the backward's delta term."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(10), 2, 128, 128, 32)
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True, impl=impl)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_oracle(q, k, v):
+        o, lse = _oracle(q, k, v, True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-2, atol=1e-2,
+            err_msg=f"d{name} mismatch ({impl})",
+        )
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("offset", [64, 128, 100])
+def test_q_pos_offset_matches_shifted_oracle(impl, offset):
+    """q_pos_offset shifts the queries' global positions right of the keys
+    (a ring hop attending an earlier K/V shard): fwd and bwd must equal the
+    oracle under the shifted causal mask. offset=100 is deliberately not
+    tile-aligned (markers the mask-only path); 64/128 hit tile-aligned
+    mappings."""
+    b, s, d = 2, 128, 32
+    q, k, v = _make_qkv(jax.random.PRNGKey(11), b, s, s, d)
+    qi = offset + jnp.arange(s)[:, None]
+    kj = jnp.arange(s)[None, :]
+    mask = qi >= kj
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=True, impl=impl, q_tile=64, k_tile=64,
+            q_pos_offset=offset,
+        )
+        return jnp.sum(o ** 2) + jnp.sum(lse), (o, lse)
+
+    def loss_oracle(q, k, v):
+        o, lse = attention_with_lse(q, k, v, mask)
+        return jnp.sum(o ** 2) + jnp.sum(lse), (o, lse)
+
+    (l, (o, lse)), g = jax.value_and_grad(
+        loss_flash, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (l_ref, (o_ref, lse_ref)), g_ref = jax.value_and_grad(
+        loss_oracle, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref), rtol=1e-2, atol=1e-2)
+    for got, want, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-2, atol=1e-2,
+            err_msg=f"d{name} mismatch ({impl}, offset={offset})",
+        )
+
+
+@pytest.mark.parametrize("impl", ["reference", "pallas"])
+def test_q_pos_offset_with_window(impl):
+    """Offset + sliding window: the banded grids follow the shifted
+    diagonal (tile-aligned offset) and masking stays exact. offset=64 keeps
+    every query row inside the window of some key (an all-masked row is
+    well-defined for the flash kernels — zero output — but the dense
+    oracle's -1e30 fill degenerates to uniform softmax there, so rows must
+    stay populated for an oracle comparison)."""
+    b, s, d, window, offset = 2, 256, 16, 100, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(12), b, s, s, d)
+    qi = offset + jnp.arange(s)[:, None]
+    kj = jnp.arange(s)[None, :]
+    mask = (qi >= kj) & (qi - kj < window)
+
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, impl=impl, window=window,
+            q_tile=64, k_tile=64, q_pos_offset=offset) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(attention_with_lse(q, k, v, mask)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w, nm in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-2, atol=2e-2,
+            err_msg=f"d{nm} mismatch ({impl})",
+        )
+
+    # fully out-of-window hop: offset so large every key is stale. The
+    # contract for all-masked rows is lse ≈ -inf (so an online-softmax
+    # merge weights the block by exp(lse - anything) = 0); the o rows are
+    # unspecified (the banded grid skips them to zero, mask-only paths
+    # compute a degenerate mean that the zero weight discards).
+    _, far_lse = flash_attention_with_lse(
+        q, k, v, causal=True, impl=impl, window=64,
+        q_tile=64, k_tile=64, q_pos_offset=4096,
+    )
+    assert np.all(np.asarray(far_lse) < -1e29)
 
 
 # ---------------------------------------------------------------------------
