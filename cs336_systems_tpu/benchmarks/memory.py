@@ -3,10 +3,27 @@
 Reference parity (cs336_systems/benchmark.py:175-245, 314-353): profile the
 big model at ctx {128, 256, 512}, forward-only vs full training step,
 fp32 vs bf16, dumping an allocator snapshot per cell plus a peak-memory
-table. The reference dumps ``torch.cuda.memory`` pickles; here each cell
-writes a pprof-format ``jax.profiler.device_memory_profile`` (live HBM
-buffers by allocation site — TensorBoard memory_viewer / pprof readable)
-and the table records the backend allocator's peak-bytes counter.
+table.
+
+Two measurement modes:
+
+- **Compile-time analysis (default, ``analyze_memory_cell``)** — lower the
+  jitted step over abstract ``ShapeDtypeStruct`` inputs and read the XLA
+  buffer-assignment peak from ``compiled.memory_analysis()``. This is the
+  exact number the runtime will reserve (XLA preallocates its buffer
+  assignment; there is no allocator timeline to sample on TPU the way
+  ``torch.cuda.memory`` records one), it varies with ctx/phase/dtype the
+  way the reference's snapshots do, and it needs ZERO device memory — the
+  2.7b cells are analyzed without ever materializing the model. Validated
+  on-chip: a real 2.7b fullstep at the analyzed batch executes while
+  batch sizes whose analyzed peak exceeds HBM abort in allocation (see
+  results/memory_v5e.txt).
+- **Runtime accounting (``profile_memory_cell``)** — actually run the cell
+  and read the backend allocator's peak counter, dumping a pprof-format
+  ``jax.profiler.device_memory_profile`` per cell (TensorBoard
+  memory_viewer readable — the reference pickles' analogue). Backends
+  without allocator stats (some PJRT plugins) report only live-array
+  bytes; the analysis mode has no such dependency.
 """
 
 from __future__ import annotations
@@ -26,6 +43,155 @@ from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
 from cs336_systems_tpu.train import lm_loss, make_train_step
 from cs336_systems_tpu.utils.profiling import memory_snapshot, memory_stats, peak_bytes
 from cs336_systems_tpu.utils.timing import error_cell, print_table, results_table
+
+
+def _parse_oom_demand(msg: str) -> tuple[float | None, float | None]:
+    """Extract (total demand bytes, HBM limit bytes) from an XLA:TPU
+    'Ran out of memory in memory space hbm' compile error. The compiler
+    prints ``Total hbm usage >= X`` (full buffer-assignment demand) and
+    ``Used X of Y hbm``; returns (None, None) when the error is not an
+    HBM-capacity failure."""
+    import re
+
+    mult = {"K": 2**10, "M": 2**20, "G": 2**30, "B": 1}
+    total = re.search(r"Total hbm usage >= ([0-9.]+)([KMGB])", msg)
+    used = re.search(r"Used ([0-9.]+)([KMGB]) of ([0-9.]+)([KMGB]) hbm", msg)
+    peak = None
+    if total:
+        peak = float(total.group(1)) * mult[total.group(2)]
+    elif used:
+        peak = float(used.group(1)) * mult[used.group(2)]
+    limit = float(used.group(3)) * mult[used.group(4)] if used else None
+    return peak, limit
+
+
+def analyze_memory_cell(
+    size: str,
+    context_length: int,
+    full_step: bool,
+    compute_dtype: str = "float32",
+    batch_size: int = 4,
+    vocab_size: int = 10_000,
+    donate: bool = True,
+    seed: int = 0,
+    **cfg_overrides,
+) -> dict:
+    """Compile one {size, ctx, phase, dtype} cell over abstract inputs and
+    return XLA's buffer-assignment peak — the HBM the cell needs to run.
+
+    ``donate`` mirrors the real training path (``make_train_step`` donates
+    params/opt-state, letting XLA alias the update in place); pass False
+    for the no-aliasing upper bound. The forward phase has nothing to
+    donate (loss only), matching the reference's forward-only snapshots.
+
+    ``cfg_overrides`` forward to the TransformerConfig (e.g.
+    ``attn_impl="flash"``, ``remat=True``, ``scan_layers=False``) — the
+    memory question "what does the flash kernel / remat buy" is answered by
+    diffing cells that differ only in these.
+    """
+    import functools
+
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    cfg = config_for_size(
+        size,
+        vocab_size=vocab_size,
+        context_length=context_length,
+        compute_dtype=compute_dtype,
+        **cfg_overrides,
+    )
+    # abstract shapes only — a 2.7b cell costs no device memory to analyze
+    params_s = jax.eval_shape(
+        functools.partial(init_transformer_lm, cfg=cfg), jax.random.PRNGKey(seed)
+    )
+    batch_s = jax.ShapeDtypeStruct((batch_size, context_length), jnp.int32)
+
+    if full_step:
+        from cs336_systems_tpu.train import make_update_fn
+
+        update = make_update_fn(
+            functools.partial(lm_loss, cfg=cfg), AdamWHparams(lr=1e-4),
+            clip_norm=None,
+        )
+        opt_s = jax.eval_shape(adamw_init, params_s)
+        fn = jax.jit(update, donate_argnums=(0, 1) if donate else ())
+        lowered = fn.lower(params_s, opt_s, batch_s, batch_s)
+    else:
+        lowered = jax.jit(
+            lambda p, x, y: lm_loss(p, x, y, cfg)
+        ).lower(params_s, batch_s, batch_s)
+
+    mb = lambda b: round(b / 2**20, 1)
+    cell = {
+        "size": size,
+        "ctx": context_length,
+        "phase": "fullstep" if full_step else "forward",
+        "dtype": compute_dtype,
+        "batch": batch_size,
+        "donate": donate and full_step,
+        "backend": jax.devices()[0].platform,
+    }
+    try:
+        ma = lowered.compile().memory_analysis()
+        if ma is None:  # PJRT plugins may return None instead of raising
+            raise RuntimeError(
+                f"backend {jax.devices()[0].platform!r} does not implement "
+                "compiled memory analysis; use --mode runtime"
+            )
+    except Exception as e:  # over-HBM: the TPU compiler refuses the program
+        # but its error reports the full buffer-assignment demand — parse it
+        # so cells that cannot fit one chip still get their true number
+        # (the reference could record these: 80 GB A100 vs 16 GB v5e).
+        peak, limit = _parse_oom_demand(str(e))
+        if peak is None:
+            raise
+        return {
+            **cell,
+            "peak_mb": mb(peak),
+            "limit_mb": mb(limit) if limit else None,
+            "fits_hbm": False,
+        }
+    return {
+        **cell,
+        "peak_mb": mb(ma.peak_memory_in_bytes),
+        "args_mb": mb(ma.argument_size_in_bytes),
+        "temp_mb": mb(ma.temp_size_in_bytes),
+        "out_mb": mb(ma.output_size_in_bytes),
+        "alias_mb": mb(ma.alias_size_in_bytes),
+        "fits_hbm": True,
+    }
+
+
+def run_memory_analysis(
+    size: str = "2.7b",
+    context_lengths=(128, 256, 512),
+    dtypes=("float32", "bfloat16"),
+    batch_size: int = 4,
+    donate: bool = True,
+    oom_ok: bool = True,
+):
+    """Compile-time grid sweep (see module docstring); no device memory
+    needed, so every reference cell — including all of 2.7b — gets a row."""
+    rows = []
+    for ctx in context_lengths:
+        for dtype in dtypes:
+            for full_step in (False, True):
+                try:
+                    rows.append(
+                        analyze_memory_cell(
+                            size, ctx, full_step, compute_dtype=dtype,
+                            batch_size=batch_size, donate=donate,
+                        )
+                    )
+                except Exception as e:
+                    if not oom_ok:
+                        raise
+                    rows.append(
+                        {"size": size, "ctx": ctx,
+                         "phase": "fullstep" if full_step else "forward",
+                         "dtype": dtype, "error": error_cell(e)}
+                    )
+    return results_table(rows)
 
 
 def profile_memory_cell(
@@ -153,13 +319,22 @@ def main(argv=None) -> None:
     p.add_argument("--ctx", nargs="+", type=int, default=[128, 256, 512])
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--batch", type=int, default=4)
-    p.add_argument("--snapshot-dir", default="memory_files")
+    p.add_argument("--snapshot-dir", default=None,
+                   help="runtime mode: where device_memory_profile dumps go "
+                        "(default memory_files)")
     p.add_argument("--no-snapshots", dest="snapshots", action="store_false",
                    help="skip device_memory_profile dumps (some PJRT "
                         "plugins hard-abort on the heap-profile C API); "
                         "peak/live byte accounting still runs")
     p.add_argument("--no-isolate", action="store_true",
                    help="share one process (peaks become upper bounds)")
+    p.add_argument("--mode", choices=["analyze", "runtime"], default="analyze",
+                   help="analyze: compile-time buffer-assignment peaks over "
+                        "abstract shapes (default; covers 2.7b with zero HBM); "
+                        "runtime: execute each cell and read allocator stats")
+    p.add_argument("--no-donate", action="store_true",
+                   help="analyze the fullstep without params/opt donation "
+                        "(the no-aliasing upper bound)")
     p.add_argument("--cell", default=None, help=argparse.SUPPRESS)  # internal
     args = p.parse_args(argv)
 
@@ -175,12 +350,27 @@ def main(argv=None) -> None:
         print(json.dumps(row))
         return
 
-    df = run_memory_benchmark(
-        size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
-        batch_size=args.batch,
-        snapshot_dir=args.snapshot_dir if args.snapshots else None,
-        isolate=not args.no_isolate,
-    )
+    if args.mode == "analyze":
+        # reject runtime-only flags instead of silently measuring something
+        # other than what the caller asked for
+        if args.snapshot_dir is not None or not args.snapshots or args.no_isolate:
+            raise SystemExit(
+                "--snapshot-dir/--no-snapshots/--no-isolate only apply to "
+                "--mode runtime (analyze compiles over abstract shapes; "
+                "there is no allocator to snapshot)"
+            )
+        df = run_memory_analysis(
+            size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
+            batch_size=args.batch, donate=not args.no_donate,
+        )
+    else:
+        df = run_memory_benchmark(
+            size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
+            batch_size=args.batch,
+            snapshot_dir=(args.snapshot_dir or "memory_files")
+            if args.snapshots else None,
+            isolate=not args.no_isolate,
+        )
     print_table(df)
 
 
