@@ -90,6 +90,28 @@ def fsdp_init(params, mesh: Mesh, axis: str = "dp"):
     }
 
 
+def fsdp_restore(state, params_like, mesh: Mesh, axis: str = "dp"):
+    """Place a host FSDP state (from ``utils.checkpoint``) onto ``mesh`` —
+    re-chunked when the dp world size changed since the save (elastic
+    resume; see ``parallel.zero.rechunk_rows``)."""
+    from cs336_systems_tpu.parallel.zero import rechunk_rows
+
+    n, _ = _ravel_meta(params_like)
+    world = mesh.shape[axis]
+    sh = NamedSharding(mesh, P(axis))
+    place = lambda a: jax.device_put(
+        jnp.asarray(rechunk_rows(a, n, world), jnp.float32), sh
+    )
+    import numpy as np
+
+    return {
+        "p": place(state["p"]),
+        "m": place(state["m"]),
+        "v": place(state["v"]),
+        "t": jnp.asarray(np.asarray(state["t"]), jnp.int32),
+    }
+
+
 def fsdp_state_bytes(params, world: int) -> int:
     """Persistent per-device bytes (fp32 p + m + v chunks)."""
     n = sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))

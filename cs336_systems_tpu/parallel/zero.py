@@ -81,6 +81,50 @@ def zero1_state_bytes(params, world: int) -> int:
     return 2 * 4 * _chunk(_flat_size(params), world)
 
 
+def rechunk_rows(rows, n: int, new_world: int) -> np.ndarray:
+    """Re-chunk a ``[old_world, old_chunk]`` sharded-state matrix to
+    ``[new_world, new_chunk]``, preserving the flat prefix of length ``n``
+    (everything past ``n`` is alignment padding). The index-sharded layout
+    makes elastic resume trivial: the flat state is world-size-invariant,
+    only its chunking changes — the reference's param-granular
+    owner-assignment (ddp_bucketed_overlapped_sharded.py:342-362) would
+    have to re-balance ownership instead."""
+    rows = np.asarray(rows)
+    # alignment padding is strictly less than one element per row
+    # (old_world * ceil(n/old_world) - n < old_world), so any larger excess
+    # means the checkpoint belongs to a different model — truncating it
+    # would silently resume from garbage
+    if rows.size < n or rows.size - n >= rows.shape[0]:
+        raise ValueError(
+            f"sharded state holds {rows.size} elements ({rows.shape[0]} "
+            f"rows) but the model needs {n} — checkpoint does not match "
+            "this model"
+        )
+    flat = rows.reshape(-1)[:n]
+    chunk = _chunk(n, new_world)
+    out = np.zeros((new_world, chunk), flat.dtype)
+    out.reshape(-1)[:n] = flat
+    return out
+
+
+def zero1_restore(opt_state, params_like, mesh: Mesh, axis: str = "dp"):
+    """Place a host ZeRO-1 state (e.g. from ``utils.checkpoint``) onto
+    ``mesh`` — re-chunked when the dp world size changed since the save
+    (elastic resume). ``params_like``: arrays or eval_shape structs giving
+    the flat element count."""
+    n = _flat_size(params_like)
+    world = mesh.shape[axis]
+    sh = NamedSharding(mesh, P(axis))
+    place = lambda a: jax.device_put(
+        jnp.asarray(rechunk_rows(a, n, world), jnp.float32), sh
+    )
+    return {
+        "m": place(opt_state["m"]),
+        "v": place(opt_state["v"]),
+        "t": jnp.asarray(np.asarray(opt_state["t"]), jnp.int32),
+    }
+
+
 def make_zero1_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,

@@ -5,10 +5,12 @@ hparams (naive_ddp.py:660-729, ddp_bucketed_overlapped_sharded.py:366-419);
 this is the equivalent runnable entry point as one coherent driver: named
 model sizes, a memory-mapped token corpus (or a synthetic one for smoke
 runs), warmup-cosine schedule, periodic checkpointing with exact
-params/optimizer/step resume (the data stream re-seeds by resume step so
-no consumed batch repeats), and the parallelism layer selected by flag —
-single device, DP variants, DP+ZeRO-1, or FSDP — over however many
-devices the host sees.
+params/optimizer/step resume in EVERY mode (sharded modes persist their
+[world, chunk] optimizer rows and re-place them on the mesh at resume —
+re-chunked if the device count changed; the data stream re-seeds by resume
+step so no consumed batch repeats), and the parallelism layer selected by
+flag: single device, the DP variants, DP+ZeRO-1, FSDP, and the mesh modes
+tp / sp / pp / ep (with ``--mesh dp=2,tp=4``-style shapes).
 
 Examples::
 
@@ -19,10 +21,16 @@ Examples::
     python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel zero1 \
         --steps 5000 --checkpoint-dir ckpt --checkpoint-every 500
 
-    # resume from the last checkpoint (replicated-optimizer modes: the
-    # sharded modes save params-only checkpoints and cannot resume yet)
-    python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel bucketed \
+    # resume from the last checkpoint (any mode)
+    python -m cs336_systems_tpu.train_cli --corpus tokens.npy --parallel fsdp \
         --steps 10000 --checkpoint-dir ckpt --resume
+
+    # 2-D mesh: tensor parallel x data parallel; sequence parallel; GPipe;
+    # expert parallel over an MoE model
+    python -m cs336_systems_tpu.train_cli --synthetic --parallel tp --mesh dp=2,tp=4
+    python -m cs336_systems_tpu.train_cli --synthetic --parallel sp
+    python -m cs336_systems_tpu.train_cli --synthetic --parallel pp --microbatches 8
+    python -m cs336_systems_tpu.train_cli --synthetic --parallel ep --experts 8
 """
 
 from __future__ import annotations
@@ -62,10 +70,49 @@ def _load_corpus(args) -> np.ndarray:
     return np.memmap(args.corpus, dtype=args.corpus_dtype, mode="r")
 
 
+class _Layer:
+    """What ``main`` needs from a parallelism layer, in one bundle.
+
+    ``init(key) -> state``; ``run(state, ...) -> (state, loss[, key])``;
+    ``to_params(state)`` materializes the full params pytree (eval /
+    checkpoint / param count); ``to_opt(state)`` is whatever optimizer
+    pytree the layer persists for exact resume; ``restore(ck) -> state``
+    rebuilds the training state from a loaded checkpoint dict (placing
+    sharded state back onto the mesh — see zero1_restore/fsdp_restore).
+    """
+
+    def __init__(self, init, run, to_params, mesh, to_opt, restore,
+                 batch_spec=None):
+        self.init, self.run, self.to_params = init, run, to_params
+        self.mesh, self.to_opt, self.restore = mesh, to_opt, restore
+        # PartitionSpec for placing host batches ("dp" over the batch dim
+        # unless a mode says otherwise — sp also shards the sequence dim)
+        from jax.sharding import PartitionSpec as P
+
+        self.batch_spec = batch_spec if batch_spec is not None else P("dp")
+
+    @property
+    def batch_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.batch_spec)
+
+
+def _require_opt(ck):
+    if ck["opt_state"] is None:
+        raise SystemExit(
+            "checkpoint has no opt_state.npz (params-only checkpoint) — "
+            "cannot resume training from it"
+        )
+    return ck["opt_state"]
+
+
 def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
-           donate: bool, loop_chunk: int = 1):
-    """Returns (state_init, step_fn, state_to_params, mesh) for the chosen
-    parallelism layer; state is whatever pytree the layer trains.
+           donate: bool, loop_chunk: int = 1, mesh_axes: dict | None = None,
+           microbatches: int | None = None) -> _Layer:
+    """Build the chosen parallelism layer (see ``_Layer``).
 
     ``loop_chunk > 1`` (single-device only): run that many optimizer steps
     per dispatch via the in-jit ``make_sampled_train_loop`` — batches are
@@ -73,8 +120,14 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
     ``(state, corpus_dev, key, batch_size)``; on remote-dispatch runtimes
     one host round-trip per step dominates otherwise (measured 6.7k vs
     126k tokens/s on the tunneled v5e).
+
+    ``mesh_axes``: explicit mesh shape (e.g. {"dp": 2, "tp": 4}) for the
+    mesh-sharded modes; defaults put all devices on the mode's inner axis.
     """
     from cs336_systems_tpu.train import init_train_state, make_train_step
+
+    def replicated_restore(ck):
+        return (ck["params"], _require_opt(ck))
 
     if parallel == "none":
         def init(key):
@@ -94,7 +147,8 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
                 )
                 return (params, opt), losses[-1], key
 
-            return init, run, lambda s: s[0], None
+            return _Layer(init, run, lambda s: s[0], None,
+                          lambda s: s[1], replicated_restore)
 
         step = make_train_step(cfg, hp, lr_schedule=schedule, donate=donate)
 
@@ -103,11 +157,19 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             params, opt, loss = step(params, opt, x, y)
             return (params, opt), loss
 
-        return init, run, lambda s: s[0], None
+        return _Layer(init, run, lambda s: s[0], None,
+                      lambda s: s[1], replicated_restore)
 
     from cs336_systems_tpu.parallel.mesh import make_mesh
 
-    mesh = make_mesh({"dp": len(jax.devices())})
+    n_dev = len(jax.devices())
+    if parallel in ("naive", "flat", "bucketed", "zero1", "fsdp"):
+        mesh = make_mesh(mesh_axes or {"dp": n_dev})
+        if tuple(mesh.axis_names) != ("dp",):
+            raise SystemExit(
+                f"--parallel {parallel} uses a pure dp mesh; got --mesh "
+                f"{dict(mesh.shape)}"
+            )
     if parallel in ("naive", "flat", "bucketed"):
         from cs336_systems_tpu.parallel.dp import make_dp_train_step
         from cs336_systems_tpu.train import init_train_state
@@ -124,12 +186,14 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             params, opt, loss = step(params, opt, x, y)
             return (params, opt), loss
 
-        return init, run, lambda s: s[0], mesh
+        return _Layer(init, run, lambda s: s[0], mesh,
+                      lambda s: s[1], replicated_restore)
     if parallel == "zero1":
         from cs336_systems_tpu.models.transformer import init_transformer_lm
         from cs336_systems_tpu.parallel.zero import (
             make_zero1_train_step,
             zero1_init,
+            zero1_restore,
         )
 
         step = make_zero1_train_step(
@@ -148,12 +212,18 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             params, z, loss = step(params, z, x, y)
             return (params, z), loss
 
-        return init, run, lambda s: s[0], mesh
+        def restore(ck):
+            params = ck["params"]
+            return (params, zero1_restore(_require_opt(ck), params, mesh))
+
+        return _Layer(init, run, lambda s: s[0], mesh,
+                      lambda s: s[1], restore)
     if parallel == "fsdp":
         from cs336_systems_tpu.models.transformer import init_transformer_lm
         from cs336_systems_tpu.parallel.fsdp import (
             fsdp_gather_params,
             fsdp_init,
+            fsdp_restore,
             make_fsdp_train_step,
         )
 
@@ -172,8 +242,108 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             state, loss = step(state, x, y)
             return state, loss
 
-        return init, run, lambda s: fsdp_gather_params(s, params_like), mesh
+        return _Layer(
+            init, run, lambda s: fsdp_gather_params(s, params_like), mesh,
+            lambda s: s,  # the whole state (fp32 master chunks + m/v + t)
+            lambda ck: fsdp_restore(_require_opt(ck), params_like, mesh),
+        )
+    if parallel in ("tp", "sp", "pp", "ep"):
+        return _build_mesh_mode(
+            cfg, hp, schedule, parallel, donate, mesh_axes, microbatches
+        )
     raise SystemExit(f"unknown --parallel {parallel!r}")
+
+
+def _build_mesh_mode(cfg, hp, schedule, parallel, donate, mesh_axes,
+                     microbatches) -> _Layer:
+    """tp / sp / pp / ep layers: a 1-D inner mesh by default, or the
+    ``--mesh`` shape (e.g. dp=2,tp=4) for 2-D composition. State is always
+    ``(params, adamw_opt_state)`` with the mode's sharding layout; resume
+    re-places the host checkpoint through the same shard functions."""
+    from jax.sharding import PartitionSpec as P
+
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.optim.adamw import adamw_init
+    from cs336_systems_tpu.parallel.mesh import make_mesh, shard_tree
+
+    n_dev = len(jax.devices())
+    inner = parallel  # axis name matches the mode
+    mesh = make_mesh(mesh_axes or {inner: n_dev})
+    if inner not in mesh.shape:
+        raise SystemExit(
+            f"--parallel {parallel} needs a {inner!r} mesh axis; got "
+            f"--mesh {dict(mesh.shape)}"
+        )
+    has_dp = "dp" in mesh.shape
+
+    if parallel == "tp":
+        from cs336_systems_tpu.parallel import tp as mode
+        from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+        step = mode.make_tp_train_step(
+            cfg, hp, mesh, lr_schedule=schedule, donate=donate
+        )
+        pspecs = mode.param_specs(cfg)
+        ospecs = adamw_state_specs(pspecs)
+        place = lambda p, o: (
+            shard_tree(p, mesh, pspecs), shard_tree(o, mesh, ospecs)
+        )
+        batch_spec = P("dp") if has_dp else P()
+    elif parallel == "sp":
+        from cs336_systems_tpu.parallel import sp as mode
+
+        step = mode.make_sp_train_step(
+            cfg, hp, mesh, lr_schedule=schedule, donate=donate
+        )
+        place = lambda p, o: (p, o)  # replicated
+        batch_spec = P("dp" if has_dp else None, "sp")
+    elif parallel == "pp":
+        from cs336_systems_tpu.parallel import pp as mode
+        from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+        step = mode.make_pp_train_step(
+            cfg, hp, mesh, num_microbatches=microbatches,
+            lr_schedule=schedule, donate=donate,
+        )
+        pspecs = mode.param_specs(cfg)
+        ospecs = adamw_state_specs(pspecs)
+        place = lambda p, o: (
+            shard_tree(p, mesh, pspecs), shard_tree(o, mesh, ospecs)
+        )
+        batch_spec = P("dp") if has_dp else P()
+    else:  # ep
+        if cfg.num_experts <= 0:
+            raise SystemExit(
+                "--parallel ep trains an MoE model: pass --experts N "
+                "(and optionally --moe-top-k)"
+            )
+        from cs336_systems_tpu.parallel import ep as mode
+        from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+        step = mode.make_ep_train_step(
+            cfg, hp, mesh, lr_schedule=schedule, donate=donate
+        )
+        pspecs = mode.param_specs(cfg)
+        ospecs = adamw_state_specs(pspecs)
+        place = lambda p, o: (
+            shard_tree(p, mesh, pspecs), shard_tree(o, mesh, ospecs)
+        )
+        batch_spec = P("dp") if has_dp else P()
+
+    def init(key):
+        params = init_transformer_lm(key, cfg)
+        return place(params, adamw_init(params))
+
+    def run(state, x, y):
+        params, opt = state
+        params, opt, loss = step(params, opt, x, y)
+        return (params, opt), loss
+
+    def restore(ck):
+        return place(ck["params"], _require_opt(ck))
+
+    return _Layer(init, run, lambda s: s[0], mesh, lambda s: s[1], restore,
+                  batch_spec=batch_spec)
 
 
 def main(argv=None) -> None:
@@ -199,7 +369,18 @@ def main(argv=None) -> None:
                    choices=[None, "flash", "xla", "flash_ref"],
                    help="attention impl (default flash on TPU, xla elsewhere)")
     p.add_argument("--parallel", default="none",
-                   choices=["none", "naive", "flat", "bucketed", "zero1", "fsdp"])
+                   choices=["none", "naive", "flat", "bucketed", "zero1",
+                            "fsdp", "tp", "sp", "pp", "ep"])
+    p.add_argument("--mesh", default=None,
+                   help="mesh shape for the sharded modes, e.g. 'dp=2,tp=4' "
+                        "(default: all devices on the mode's own axis)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="GPipe microbatches (--parallel pp; default = "
+                        "pipeline width)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="MoE experts per block (0 = dense; required >0 for "
+                        "--parallel ep)")
+    p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--corpus", default=None, help="token array (.npy or raw)")
     p.add_argument("--corpus-dtype", default="uint16")
     p.add_argument("--synthetic", action="store_true",
@@ -230,6 +411,8 @@ def main(argv=None) -> None:
         )
         if v is not None
     }
+    if args.experts:
+        overrides.update(num_experts=args.experts, moe_top_k=args.moe_top_k)
     cfg = config_for_size(
         args.size,
         context_length=args.ctx,
@@ -239,6 +422,22 @@ def main(argv=None) -> None:
         scan_layers=not on_tpu,
         **overrides,
     )
+    mesh_axes = None
+    if args.mesh:
+        if args.parallel == "none":
+            raise SystemExit(
+                "--mesh has no effect with --parallel none; pick a sharded "
+                "mode (naive/flat/bucketed/zero1/fsdp/tp/sp/pp/ep)"
+            )
+        try:
+            mesh_axes = {
+                k.strip(): int(v)
+                for k, v in (kv.split("=") for kv in args.mesh.split(","))
+            }
+        except ValueError:
+            raise SystemExit(f"--mesh must look like 'dp=2,tp=4'; got {args.mesh!r}")
+    if args.microbatches is not None and args.parallel != "pp":
+        raise SystemExit("--microbatches only applies to --parallel pp")
     hp = AdamWHparams(lr=args.lr, weight_decay=args.weight_decay)
     schedule = functools.partial(
         get_cosine_lr,
@@ -272,9 +471,11 @@ def main(argv=None) -> None:
 
     # Donation is safe with checkpointing: save_checkpoint pulls the state
     # to host before the next run() call consumes the donated buffers.
-    init, run, to_params, mesh = _build(
-        cfg, hp, schedule, args.parallel, donate=True, loop_chunk=loop_chunk
+    layer = _build(
+        cfg, hp, schedule, args.parallel, donate=True, loop_chunk=loop_chunk,
+        mesh_axes=mesh_axes, microbatches=args.microbatches,
     )
+    run, to_params, mesh = layer.run, layer.to_params, layer.mesh
     run_one = None
     if loop_chunk > 1:
         from cs336_systems_tpu.train import make_train_step
@@ -286,26 +487,19 @@ def main(argv=None) -> None:
             params, opt, loss = _tail(*state, x, y)
             return (params, opt), loss
 
-    state = init(jax.random.PRNGKey(args.seed))
     start_step = 0
     if args.resume:
         if not args.checkpoint_dir:
             raise SystemExit("--resume requires --checkpoint-dir")
         ck = load_checkpoint(args.checkpoint_dir)
-        if args.parallel not in ("none", "naive", "flat", "bucketed"):
-            raise SystemExit(
-                "--resume currently supports the replicated-optimizer modes "
-                "(none/naive/flat/bucketed) — zero1/fsdp checkpoints are "
-                "params-only and cannot restore the sharded optimizer state"
-            )
-        if ck["opt_state"] is None:
-            raise SystemExit(
-                f"{args.checkpoint_dir} has no opt_state.npz (params-only "
-                "checkpoint) — cannot resume training from it"
-            )
-        state = (ck["params"], ck["opt_state"])
+        # every mode restores exactly — the sharded ones re-place their
+        # [world, chunk] state onto the mesh (re-chunked if the device
+        # count changed; parallel.zero.rechunk_rows)
+        state = layer.restore(ck)
         start_step = ck["step"] or 0
         print(f"resumed from {args.checkpoint_dir} at step {start_step}")
+    else:
+        state = layer.init(jax.random.PRNGKey(args.seed))
 
     n_params = count_params(to_params(state), non_embedding=False)
     print(
@@ -315,9 +509,8 @@ def main(argv=None) -> None:
     )
 
     from cs336_systems_tpu.data.loader import get_batch
-    from cs336_systems_tpu.parallel.mesh import batch_sharding
 
-    sharding = batch_sharding(mesh) if mesh is not None else None
+    sharding = layer.batch_sharding
     # Resume continues a fresh, step-seeded data stream (params/opt/step are
     # exact; the original host-rng / sample-key positions are not persisted,
     # so re-seeding by (seed, start_step) avoids REPEATING consumed data).
@@ -350,14 +543,12 @@ def main(argv=None) -> None:
             return float(np.mean(jax.device_get(losses)))
 
     def save(step_no):
-        params = to_params(state)
-        opt = state[1] if isinstance(state, tuple) else None
+        # every mode persists its full optimizer pytree (sharded modes save
+        # their [world, chunk] rows; np.asarray gathers them to host), so
+        # every mode can --resume exactly
         save_checkpoint(
-            args.checkpoint_dir, params, config=cfg,
-            opt_state=opt
-            if args.parallel in ("none", "naive", "flat", "bucketed")
-            else None,
-            step=step_no,
+            args.checkpoint_dir, to_params(state), config=cfg,
+            opt_state=layer.to_opt(state), step=step_no,
         )
         print(f"checkpointed step {step_no} -> {args.checkpoint_dir}")
 

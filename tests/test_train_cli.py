@@ -38,15 +38,61 @@ def _reference_loss(capsys) -> float:
     return _MODE_NONE_LOSS["loss"]
 
 
-@pytest.mark.parametrize("mode", ["bucketed", "zero1", "fsdp"])
-def test_cli_parallel_modes_agree(mode, capsys):
+@pytest.mark.parametrize("mode,extra", [
+    ("bucketed", []),
+    ("zero1", []),
+    ("fsdp", []),
+    ("tp", ["--mesh", "dp=2,tp=4"]),
+    ("sp", []),
+    ("pp", ["--mesh", "dp=2,pp=2", "--microbatches", "2"]),
+])
+def test_cli_parallel_modes_agree(mode, extra, capsys):
     ref = _reference_loss(capsys)
-    main(TINY + ["--steps", "4", "--parallel", mode])
+    main(TINY + ["--steps", "4", "--parallel", mode] + extra)
     loss = _last_loss(capsys.readouterr().out)
     # same seed, same data, same update semantics in every mode
     np.testing.assert_allclose(loss, ref, atol=2e-3)
     # and the run is actually training (not NaN/degenerate)
     assert 0 < ref < 10
+
+
+def test_cli_ep_mode_trains(capsys):
+    """--parallel ep trains an MoE model (different loss surface than the
+    dense modes — aux load-balance term — so: finite and decreasing)."""
+    main(TINY + ["--steps", "12", "--parallel", "ep", "--experts", "16",
+                 "--log-every", "1"])
+    out = capsys.readouterr().out
+    losses = [
+        float(l.split("loss")[1].split()[0])
+        for l in out.splitlines()
+        if l.startswith("step") and "eval" not in l
+    ]
+    assert len(losses) >= 4 and np.isfinite(losses).all()
+    # training, not diverging (single steps can tick up: aux term)
+    assert min(losses[-3:]) < losses[0]
+
+
+def test_cli_ep_requires_experts():
+    with pytest.raises(SystemExit, match="experts"):
+        main(TINY + ["--steps", "1", "--parallel", "ep"])
+
+
+@pytest.mark.parametrize("mode", ["zero1", "fsdp"])
+def test_cli_sharded_checkpoint_resume(mode, tmp_path, capsys):
+    """Sharded modes checkpoint their [world, chunk] optimizer rows and
+    resume exactly (the bitwise oracle is tests/test_sharded_checkpoint.py;
+    this pins the CLI wiring end to end)."""
+    ck = str(tmp_path / "ck")
+    main(TINY + ["--steps", "4", "--parallel", mode, "--checkpoint-dir", ck,
+                 "--checkpoint-every", "2"])
+    first = capsys.readouterr().out
+    assert "checkpointed step 4" in first
+
+    main(TINY + ["--steps", "8", "--parallel", mode, "--checkpoint-dir", ck,
+                 "--checkpoint-every", "2", "--resume"])
+    out = capsys.readouterr().out
+    assert "resumed" in out and "step      8" in out
+    assert "step      2" not in out  # no re-run of consumed steps
 
 
 def test_cli_checkpoint_resume(tmp_path, capsys):
