@@ -111,7 +111,8 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
         from cs336_systems_tpu.models.moe import moe_ffn
 
         out, _aux = moe_ffn(
-            ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype
+            ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype,
+            dispatch=cfg.moe_dispatch,  # dp_axis never applies at decode
         )
         return out
     return swiglu(ffn_params, x, cfg.cdtype)

@@ -4,22 +4,35 @@ A second model family beyond the reference's dense Transformer (the
 reference has no MoE anywhere — this is part of the complete framework
 surface, and the substrate for expert parallelism in ``parallel/ep.py``).
 
-TPU-first design — GShard/Mesh-TensorFlow style DENSE dispatch:
+Two dispatch schemes, same routing semantics (GShard priority fill:
+top-1 claims take capacity before top-2, token order within a priority):
 
-- No scatters, no ragged shapes, no host-side routing: the router builds
-  one-hot dispatch/combine tensors [T, E, C] (T tokens, E experts, C
-  capacity slots) and the whole layer is three einsums + a vmapped expert
-  SwiGLU — everything lands on the MXU with static shapes, which is exactly
-  what XLA needs. Tokens over capacity are dropped (their combine weight is
-  zero and the residual stream carries them through), the standard
-  capacity-factor trade; a sort-based dropless dispatch is the documented
-  upgrade for very large T·E·C.
-- Routing runs in fp32 (softmax over expert logits) regardless of the
-  compute dtype; expert weights match the dense SwiGLU init so a 1-expert
-  MoE is numerically the dense layer.
-- The load-balancing auxiliary loss is the GShard formulation:
-  ``E · Σ_e mean_tokens(gate_e) · mean_tokens(is_top1_e)`` — differentiable
-  through the gate term.
+- ``"dense"`` — GShard/Mesh-TensorFlow one-hot dispatch/combine tensors
+  [T, E, C] (T tokens, E experts, C capacity slots); the layer is three
+  einsums + a vmapped expert SwiGLU. Everything lands on the MXU with
+  static shapes, but the dispatch einsums cost O(T·E·C·D) — fine for few
+  experts, quadratic-ish waste at many.
+- ``"sorted"`` — index-based dispatch: the router emits (expert, slot)
+  integer coordinates per claim and tokens move by ONE scatter into the
+  [E, C_buf, D] expert batch and ONE gather back, O(T·k·D) data movement
+  regardless of E. Over-capacity claims scatter out of bounds and XLA
+  drops them (mode="drop") — no masked arithmetic. This is the
+  Megablocks-style dropless *mechanism* under a static capacity bound;
+  with ``capacity_factor`` covering the worst skew nothing drops.
+
+The sorted router also supports DATA-PARALLEL-consistent routing
+(``dp_axis``): claim positions are computed in the GLOBAL (j, shard,
+token) fill order via a per-expert count all-gather, so which tokens drop
+matches the full-batch single-device model exactly — the per-shard
+capacity artifact the plain per-shard router has (parallel/dp.py) goes
+away. Expert compute is per-token, so token-level outputs then equal the
+full-batch model's bit-for-bit.
+
+Shared numerics: routing runs in fp32 (softmax over expert logits)
+regardless of compute dtype; expert weights match the dense SwiGLU init
+so a 1-expert MoE is numerically the dense layer; the load-balancing aux
+loss is the GShard formulation ``E · Σ_e mean(gate_e) · mean(top1_e)``,
+differentiable through the gate term.
 """
 
 from __future__ import annotations
@@ -84,27 +97,153 @@ def route_topk(gates: jax.Array, top_k: int, capacity: int):
     return dispatch, combine, aux
 
 
+def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
+                       dp_axis: str | None = None):
+    """Index-form routing: the same GShard priority fill as ``route_topk``
+    but emitting integer coordinates instead of one-hot tensors.
+
+    Returns ``(expert [T,k] int32, pos [T,k] int32, weight [T,k] fp32,
+    aux scalar)`` where ``pos`` is the claim's position in its expert's
+    fill order — claims with ``pos >= capacity`` are the dropped ones
+    (callers scatter with mode="drop", so they simply never land).
+
+    ``dp_axis``: compute positions in the GLOBAL fill order across the
+    data-parallel axis (shards hold contiguous token ranges, so the global
+    (priority, shard, token) order IS the full-batch (priority, token)
+    order). Costs one [W, E] all-gather of per-expert counts per priority
+    — a few KB — and makes drop decisions match the full-batch model
+    exactly; ``capacity`` must then be the GLOBAL capacity.
+    """
+    t, e = gates.shape
+    vals, idx = jax.lax.top_k(gates, top_k)  # [T, k]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    fill = jnp.zeros((e,), jnp.int32)  # occupancy entering this priority
+    pos_cols = []
+    for j in range(top_k):  # top_k is small and static
+        onehot = jax.nn.one_hot(idx[:, j], e, dtype=jnp.int32)  # [T, E]
+        local_count = jnp.sum(onehot, axis=0)  # [E]
+        if dp_axis is not None:
+            counts = jax.lax.all_gather(local_count, dp_axis)  # [W, E]
+            w = jax.lax.axis_index(dp_axis)
+            prev_shards = jnp.sum(
+                jnp.where(jnp.arange(counts.shape[0])[:, None] < w, counts, 0),
+                axis=0,
+            )
+            offset = fill + prev_shards
+            fill = fill + jnp.sum(counts, axis=0)
+        else:
+            offset = fill
+            fill = fill + local_count
+        pos_if = jnp.cumsum(onehot, axis=0) - 1 + offset[None, :]
+        pos_cols.append(jnp.sum(pos_if * onehot, axis=-1))  # [T]
+    pos = jnp.stack(pos_cols, axis=1)  # [T, k]
+
+    top1 = jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32)
+    if dp_axis is None:
+        aux = e * jnp.sum(jnp.mean(gates, axis=0) * jnp.mean(top1, axis=0))
+    else:
+        # Global aux: means over ALL tokens via pmean (equal shard sizes →
+        # true global means). A per-shard aux would be a mean of per-shard
+        # PRODUCTS — a different function than the full-batch model's.
+        # Gradients need no correction: shard_map transposes psum as psum,
+        # so each shard's backward already carries the full global aux
+        # gradient for its local gates, and the DP layer's gradient pmean
+        # leaves the (identical-across-shards) result unchanged.
+        m_g = jax.lax.pmean(jnp.mean(gates, axis=0), dp_axis)
+        m_t = jax.lax.pmean(jnp.mean(top1, axis=0), dp_axis)
+        aux = e * jnp.sum(m_g * m_t)
+    return idx.astype(jnp.int32), pos, vals, aux
+
+
+def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
+                    dp_axis: str | None):
+    """Scatter/gather dispatch (see module docstring). xt: [T, D]."""
+    t, d = xt.shape
+    e = params["router"]["weight"].shape[0]
+    in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+
+    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    expert, pos, weight, aux = route_topk_indexed(
+        gates, top_k, capacity, dp_axis
+    )
+
+    # Local buffer: a shard can land at most min(capacity, T·k) of its own
+    # claims; under dp the GLOBAL pos can exceed the local buffer, so
+    # re-index kept claims by their LOCAL kept-rank per expert (expert
+    # compute is per-token — slot identity does not affect values).
+    c_buf = min(capacity, t * top_k)
+    keep = pos < capacity  # [T, k] bool, global-consistent under dp
+    flat_e = expert.reshape(-1)
+    flat_keep = keep.reshape(-1)
+    kept_onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32) * flat_keep[:, None]
+    local_rank = jnp.sum(
+        (jnp.cumsum(kept_onehot, axis=0) - kept_onehot) * kept_onehot, axis=-1
+    )
+    # dropped claims -> slot c_buf (out of bounds): scatter mode="drop"
+    # discards them, gather mode="fill" reads them as zero
+    slot = jnp.where(flat_keep, local_rank, c_buf)
+
+    token = jnp.repeat(jnp.arange(t), top_k)  # claim -> source token
+    xe = (
+        jnp.zeros((e, c_buf, d), in_dtype)
+        .at[flat_e, slot]
+        .set(xt.astype(in_dtype)[token], mode="drop")
+    )
+    ye = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))(params["experts"], xe)
+    back = ye.astype(jnp.float32).at[flat_e, slot].get(
+        mode="fill", fill_value=0.0
+    )  # [T·k, D]
+    out = jnp.sum(
+        back.reshape(t, top_k, d)
+        * (weight * keep.astype(jnp.float32))[..., None],
+        axis=1,
+    )
+    return out.astype(in_dtype), aux
+
+
 def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
-            compute_dtype=None):
+            compute_dtype=None, dispatch: str = "dense",
+            dp_axis: str | None = None, global_tokens: int | None = None):
     """MoE SwiGLU: [..., S, D] -> ([..., S, D], aux loss scalar).
 
-    Three einsums around a vmapped expert SwiGLU:
-    dispatch ([T,E,C] × [T,D] → [E,C,D]) → experts → combine back.
+    ``dispatch``: "dense" (one-hot einsums) or "sorted" (index scatter /
+    gather) — same routing decisions, different data movement (module
+    docstring). ``dp_axis`` (sorted only): full-batch-consistent routing
+    under data parallelism; ``global_tokens`` overrides the token count
+    used for capacity (defaults to T · axis size).
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
     xt = x.reshape(-1, d)  # [T, D]
     t = xt.shape[0]
     e = params["router"]["weight"].shape[0]
+
+    if dispatch == "sorted":
+        if dp_axis is not None:
+            t_cap = global_tokens or t * jax.lax.axis_size(dp_axis)
+        else:
+            t_cap = t
+        c = moe_capacity(t_cap, e, top_k, capacity_factor)
+        out, aux = _moe_ffn_sorted(params, xt, top_k, c, compute_dtype, dp_axis)
+        return out.reshape(*lead, d), aux
+    if dp_axis is not None:
+        raise ValueError(
+            "dp_axis-consistent routing requires dispatch='sorted' (the "
+            "dense one-hot dispatch has no global-position form)"
+        )
+    if dispatch != "dense":
+        raise ValueError(f"unknown moe dispatch {dispatch!r}")
     c = moe_capacity(t, e, top_k, capacity_factor)
 
     router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
     gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E] fp32
-    dispatch, combine, aux = route_topk(gates, top_k, c)
+    dispatch_t, combine, aux = route_topk(gates, top_k, c)
 
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
     xe = jnp.einsum(
-        "tec,td->ecd", dispatch.astype(in_dtype), xt.astype(in_dtype),
+        "tec,td->ecd", dispatch_t.astype(in_dtype), xt.astype(in_dtype),
         preferred_element_type=jnp.float32,
     ).astype(in_dtype)  # [E, C, D]
 

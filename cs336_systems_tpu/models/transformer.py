@@ -82,6 +82,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance aux loss weight in lm_loss
+    # "dense" one-hot einsum dispatch or "sorted" scatter/gather dispatch
+    # (see models/moe.py); "sorted" + moe_dp_axis gives full-batch-
+    # consistent routing under data parallelism (set by the DP builder).
+    moe_dispatch: str = "dense"
+    moe_dp_axis: str | None = None
 
     def __post_init__(self):
         if self.d_model % self.num_heads != 0:
@@ -98,6 +103,13 @@ class TransformerConfig:
         if self.num_experts > 0 and self.moe_top_k > self.num_experts:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} > num_experts={self.num_experts}"
+            )
+        if self.moe_dispatch not in ("dense", "sorted"):
+            raise ValueError(f"unknown moe_dispatch: {self.moe_dispatch!r}")
+        if self.moe_dp_axis is not None and self.moe_dispatch != "sorted":
+            raise ValueError(
+                "moe_dp_axis (DP-consistent routing) requires "
+                "moe_dispatch='sorted'"
             )
 
     @property
@@ -316,6 +328,7 @@ def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig,
             h, aux = moe_ffn(
                 block_params["ffn"], h, cfg.moe_top_k,
                 cfg.moe_capacity_factor, cfg.cdtype,
+                dispatch=cfg.moe_dispatch, dp_axis=cfg.moe_dp_axis,
             )
         else:
             h = swiglu(block_params["ffn"], h, cfg.cdtype)

@@ -170,13 +170,22 @@ def make_dp_train_step(
     gradient so DP training is step-equivalent to large-batch single-device
     training.
 
-    MoE configs (num_experts > 0) train correctly — aux loss included — but
-    routing/capacity is computed per DP shard (T_local tokens), the standard
-    behavior of expert routers under data parallelism: which tokens drop at
-    capacity can differ from the single-device full-batch model, so the
-    step-equivalence guarantee above applies to dense configs.
+    MoE configs (num_experts > 0) route GLOBALLY: the builder switches the
+    model to the sorted dispatch with ``moe_dp_axis`` set, so capacity is
+    computed over the full global batch and claim positions follow the
+    full-batch fill order (one tiny [W, E] count all-gather per priority —
+    models/moe.py ``route_topk_indexed``). Which tokens drop therefore
+    matches the single-device full-batch model exactly, and the
+    step-equivalence guarantee above covers MoE configs too — drops or not.
     """
+    import dataclasses
+
     from cs336_systems_tpu.train import lm_loss, make_update_fn
+
+    if cfg.num_experts > 0 and cfg.moe_dp_axis is None:
+        cfg = dataclasses.replace(
+            cfg, moe_dispatch="sorted", moe_dp_axis=axis
+        )
 
     def synced_vag(params, x, y):
         vag = local_value_and_grad(lambda p, xx, yy: lm_loss(p, xx, yy, cfg), axis)

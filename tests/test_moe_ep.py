@@ -82,6 +82,92 @@ def test_route_topk_drops_overflow():
     assert float(jnp.sum(dispatch[:, 1])) == 0.0
 
 
+@pytest.mark.parametrize("cf", [8.0, 0.5])  # ample capacity / forced drops
+def test_sorted_dispatch_matches_dense(cf):
+    """The scatter/gather dispatch must reproduce the one-hot dispatch —
+    identical routing decisions (same GShard fill order), same outputs —
+    both when nothing drops and when capacity forces drops."""
+    key = jax.random.PRNGKey(3)
+    d, f, e = 16, 32, 4
+    moe = init_moe(key, d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 24, d))
+
+    dense_out, dense_aux = moe_ffn(x=x, params=moe, top_k=2,
+                                   capacity_factor=cf, dispatch="dense")
+    sort_out, sort_aux = moe_ffn(x=x, params=moe, top_k=2,
+                                 capacity_factor=cf, dispatch="sorted")
+    np.testing.assert_allclose(
+        np.asarray(sort_out), np.asarray(dense_out), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(float(sort_aux), float(dense_aux), rtol=1e-6)
+    if cf < 1.0:  # the with-drop case must actually drop
+        t = x.shape[0] * x.shape[1]
+        c = moe_capacity(t, e, 2, cf)
+        from cs336_systems_tpu.models.moe import route_topk_indexed
+
+        gates = jax.nn.softmax(
+            jnp.einsum("td,ed->te",
+                       x.reshape(-1, d).astype(jnp.float32),
+                       moe["router"]["weight"].astype(jnp.float32)),
+            axis=-1,
+        )
+        _, pos, _, _ = route_topk_indexed(gates, 2, c)
+        assert bool(jnp.any(pos >= c))
+
+
+def test_sorted_dispatch_grads_match_dense():
+    key = jax.random.PRNGKey(5)
+    d, f, e = 16, 32, 4
+    moe = init_moe(key, d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(6), (24, d))
+
+    def loss(params, dispatch):
+        out, aux = moe_ffn(x=x, params=params, top_k=2,
+                           capacity_factor=1.0, dispatch=dispatch)
+        return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+    g_dense = jax.grad(lambda p: loss(p, "dense"))(moe)
+    g_sort = jax.grad(lambda p: loss(p, "sorted"))(moe)
+    assert trees_allclose(g_sort, g_dense, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("cf", [8.0, 0.75])  # no-drop AND with-drop
+def test_dp_moe_step_matches_full_batch(cf):
+    """DP + MoE == single-device full-batch step, including when capacity
+    drops tokens: the DP builder switches to globally-consistent sorted
+    routing (moe_dp_axis), so drop decisions follow the global fill order."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+
+    cfg = dataclasses.replace(
+        MOE_CFG, moe_capacity_factor=cf, moe_dispatch="sorted"
+    )
+    mesh = make_mesh({"dp": 4})
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(7), (8, 32), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    ref_step = make_train_step(cfg, hp, donate=False)
+    p_ref, _, l_ref = ref_step(params, opt, x, y)
+
+    dp_step = make_dp_train_step(cfg, hp, mesh, donate=False)
+    sh = NamedSharding(mesh, P("dp"))
+    p_dp, _, l_dp = dp_step(
+        params, opt, jax.device_put(x, sh), jax.device_put(y, sh)
+    )
+
+    np.testing.assert_allclose(float(l_dp), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_dp, p_ref, rtol=1e-4, atol=1e-5)
+    if cf < 1.0:  # prove the with-drop case drops globally
+        from cs336_systems_tpu.models.moe import moe_capacity as mc
+
+        assert mc(8 * 32, cfg.num_experts, cfg.moe_top_k, cf) < (
+            8 * 32 * cfg.moe_top_k / cfg.num_experts * 2
+        )
+
+
 def test_moe_lm_trains_and_aux_finite():
     params, opt = init_train_state(jax.random.PRNGKey(0), MOE_CFG)
     step = make_train_step(MOE_CFG, AdamWHparams(lr=1e-3), donate=False)
