@@ -1,0 +1,87 @@
+"""Measure the GPipe pipeline bubble: step time vs microbatch count.
+
+Analytically the bubble fraction is (W-1)/(M+W-1) for W stages and M
+microbatches. The GPipe timing model is
+
+    t_pp(M) = kappa * (M + W - 1) / M
+
+(per-microbatch work ∝ 1/M; the schedule runs M + W - 1 microbatch
+slots). This script measures t_pp at several M, fits the single constant
+kappa by least squares, and reports the MEASURED bubble fraction
+(t - kappa)/t per M against the analytic value — agreement within a few
+percent means the schedule really pays exactly the GPipe bubble and
+nothing else grows with M.
+
+Methodology caveat (8-virtual-device CPU mesh — same status as
+results/allreduce_cpu8.txt): virtual devices timeshare one host's cores,
+so comparisons against the UNPIPELINED step are invalid here ("idle"
+pipeline stages donate their cores to busy ones); the t(M) scaling shape
+is the valid observable, and it is hardware-independent — the same fit on
+a real pp mesh measures the same schedule property over ICI.
+
+Usage: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PYTHONPATH=. python scripts/pp_bubble.py [> results/pp_cpu8.txt]
+"""
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import TransformerConfig, init_transformer_lm
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.parallel.pp import make_pp_train_step, shard_params_pp
+from cs336_systems_tpu.utils.timing import timed_total
+
+CFG = TransformerConfig(
+    vocab_size=512, context_length=128, d_model=128,
+    num_layers=8, num_heads=4, d_ff=256,
+)
+BATCH = 32
+W = 4
+
+
+def main() -> None:
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(1), (BATCH, CFG.context_length),
+                           0, CFG.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    print(f"# W={W} stages, {CFG.num_layers} layers, batch {BATCH}, "
+          f"ctx {CFG.context_length}, 8-virtual-CPU mesh")
+
+    mesh = make_mesh({"pp": W})
+    p_pp = shard_params_pp(params, mesh, CFG)
+    ms = (W, 2 * W, 4 * W)
+    times = []
+    for m in ms:
+        step = make_pp_train_step(CFG, hp, mesh, num_microbatches=m,
+                                  donate=False)
+        o_pp = adamw_init(p_pp)
+        t_pp, _ = timed_total(step, p_pp, o_pp, x, y, warmup=2, iters=8)
+        times.append(t_pp.mean_ms)
+
+    # least-squares kappa for t(M) = kappa * (M+W-1)/M
+    factors = [(m + W - 1) / m for m in ms]
+    kappa = sum(t * f for t, f in zip(times, factors)) / sum(
+        f * f for f in factors
+    )
+    print(f"GPipe-model fit: t(M) = {kappa:.0f} ms * (M+{W - 1})/M")
+    print(f"{'M':>4} {'t_pp_ms':>9} {'model_ms':>9} {'fit_err%':>9} "
+          f"{'measured_bubble%':>17} {'analytic_bubble%':>17}")
+    for m, t in zip(ms, times):
+        model = kappa * (m + W - 1) / m
+        measured = (t - kappa) / t
+        analytic = (W - 1) / (m + W - 1)
+        print(f"{m:4d} {t:9.1f} {model:9.1f} "
+              f"{(t - model) / model * 100:9.1f} "
+              f"{measured * 100:17.1f} {analytic * 100:17.1f}")
+
+
+if __name__ == "__main__":
+    main()
