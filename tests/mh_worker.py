@@ -1,0 +1,77 @@
+"""Worker for the multi-host readiness test (tests/test_multihost.py).
+
+Run as ``python mh_worker.py <process_id> <num_processes> <coordinator>``
+with JAX_PLATFORMS=cpu and --xla_force_host_platform_device_count set in
+XLA_FLAGS by the launcher. Exercises the REAL multi-host code path the
+reference fakes with mp.spawn+Gloo (tests/common.py:71-88): our
+``init_distributed`` rendezvous, one ``make_mesh`` over the global device
+view, and the unmodified DP train step — then prints the final loss and a
+parameter checksum for the parent to compare across processes and against
+the single-process run.
+"""
+
+import sys
+
+
+def main() -> None:
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from cs336_systems_tpu.parallel.mesh import init_distributed, make_mesh
+
+    assert init_distributed(coord, num_processes=nproc, process_id=pid) == nproc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cs336_systems_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer_lm,
+    )
+    from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=64, context_length=16, d_model=32,
+        num_layers=2, num_heads=4, d_ff=64,
+    )
+    mesh = make_mesh()  # all global devices on dp — unchanged user code
+    world = mesh.shape["dp"]
+
+    # identical seeds on every process -> identical host values; lift onto
+    # the global mesh via per-process local shards
+    def globalize(host, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: np.asarray(host)[idx]
+        )
+
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    opt = adamw_init(params)
+    params = jax.tree_util.tree_map(lambda a: globalize(np.asarray(a), P()), params)
+    opt = jax.tree_util.tree_map(lambda a: globalize(np.asarray(a), P()), opt)
+
+    rng = np.random.default_rng(1)
+    step = make_dp_train_step(cfg, AdamWHparams(lr=1e-3), mesh, donate=False)
+    loss = None
+    for _ in range(2):
+        x = rng.integers(0, cfg.vocab_size, (world, cfg.context_length),
+                         dtype=np.int32)
+        y = np.roll(x, -1, axis=-1)
+        params, opt, loss = step(
+            params, opt, globalize(x, P("dp")), globalize(y, P("dp"))
+        )
+
+    checksum = float(
+        sum(
+            jnp.sum(jnp.abs(leaf.addressable_data(0).astype(jnp.float64)))
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )
+    print(f"RESULT pid={pid} world={world} loss={float(loss):.8f} "
+          f"checksum={checksum:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
