@@ -62,7 +62,19 @@ def benchmark_attention_cell(
     warmup: int = 2,
     iters: int = 10,
     seed: int = 0,
+    timing: str = "wall",
 ) -> dict:
+    """One cell. ``timing``:
+
+    - "wall"  — multi-iteration loops fenced once by a device_get
+      (``timed_total``); carries the runtime's per-dispatch floor, honest
+      for big cells, useless below a few ms.
+    - "device" — profiler-trace device-lane time per call
+      (``device_time_per_call``): resolves sub-ms kernels, free of the
+      ~230 ms dispatch floor. This is what fills the short-sequence half
+      of the reference grid (benchmark_attention.py:73-111) on this
+      runtime.
+    """
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
     dt = jnp.dtype(dtype)
     q = jax.random.normal(kq, (batch, seq_len, head_dim), dt)
@@ -75,7 +87,7 @@ def benchmark_attention_cell(
 
     row = {
         "impl": impl, "seq": seq_len, "d": head_dim, "batch": batch,
-        "dtype": dtype, "causal": causal,
+        "dtype": dtype, "causal": causal, "timing": timing,
     }
 
     def cell_peak(peak_before: int) -> float | None:
@@ -86,26 +98,32 @@ def benchmark_attention_cell(
         after = peak_bytes()
         return round(after / 2**20, 1) if after > peak_before else None
 
-    # timed_total (one fence around the loop) rather than timed (per-iter
-    # fences): on remote-dispatch runtimes a per-iteration fence adds many
-    # ms of host latency to every cell, swamping sub-ms kernels. Phases fail
-    # independently — at 65k the flash FORWARD fits (O(S) memory) while any
-    # backward that materializes S×S OOMs; that asymmetry is the result.
+    def measure(fn):
+        if timing == "device":
+            from cs336_systems_tpu.utils.profiling import device_time_per_call
+
+            return device_time_per_call(fn, q, k, v, iters=iters, warmup=warmup)
+        t, _ = timed_total(fn, q, k, v, warmup=warmup, iters=iters)
+        return t.mean_ms
+
+    # Phases fail independently — at 65k the flash FORWARD fits (O(S)
+    # memory) while any backward that materializes S×S OOMs; that
+    # asymmetry is the result.
     p0 = peak_bytes()
+    t_fwd = None
     try:
-        t_fwd, _ = timed_total(fwd, q, k, v, warmup=warmup, iters=iters)
-        row["forward_ms"] = round(t_fwd.mean_ms, 3)
+        t_fwd = measure(fwd)
+        row["forward_ms"] = round(t_fwd, 3)
         row["fwd_peak_mb"] = cell_peak(p0)
     except Exception as e:  # OOM/compile failure recorded as a null cell
-        t_fwd = None
         row["forward_ms"] = None
         row["fwd_error"] = error_cell(e)
     p1 = peak_bytes()
     try:
-        t_fb, _ = timed_total(fwd_bwd, q, k, v, warmup=warmup, iters=iters)
-        row["fwd_bwd_ms"] = round(t_fb.mean_ms, 3)
+        t_fb = measure(fwd_bwd)
+        row["fwd_bwd_ms"] = round(t_fb, 3)
         if t_fwd is not None:
-            row["backward_ms"] = round(max(t_fb.mean_ms - t_fwd.mean_ms, 0.0), 3)
+            row["backward_ms"] = round(max(t_fb - t_fwd, 0.0), 3)
         row["fwd_bwd_peak_mb"] = cell_peak(p1)
     except Exception as e:
         row["fwd_bwd_ms"] = None
@@ -124,6 +142,7 @@ def run_attention_benchmark(
     iters: int = 10,
     latex_path: str | None = None,
     oom_ok: bool = True,
+    timing: str = "wall",
 ):
     """Grid sweep; with ``oom_ok`` a failing cell is recorded as a null row
     (parity with the reference's OOM-catch, benchmark_attention.py:95-109)
@@ -138,6 +157,7 @@ def run_attention_benchmark(
                             benchmark_attention_cell(
                                 impl, s, d, batch=batch, dtype=dt,
                                 causal=causal, warmup=warmup, iters=iters,
+                                timing=timing,
                             )
                         )
                     except Exception as e:
@@ -188,11 +208,17 @@ def main(argv=None) -> None:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--latex", default=None)
     p.add_argument("--plots", default=None, help="prefix for output figures")
+    p.add_argument("--timing", choices=["wall", "device"], default=None,
+                   help="device = profiler-trace device-lane time per call "
+                        "(default on TPU: resolves sub-ms cells the "
+                        "dispatch floor hides); wall = fenced host loops")
     args = p.parse_args(argv)
+    timing = args.timing or ("device" if jax.default_backend() == "tpu" else "wall")
     df = run_attention_benchmark(
         impls=args.impls, seq_lens=args.seqs, head_dims=args.dims,
         batch=args.batch, dtypes=args.dtypes, causal=not args.no_causal,
         warmup=args.warmup, iters=args.iters, latex_path=args.latex,
+        timing=timing,
     )
     print_table(df)
     if args.plots:

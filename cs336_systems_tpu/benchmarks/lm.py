@@ -53,14 +53,22 @@ def benchmark_lm_size(
     attn_impl: str = "xla",
     use_jit: bool = True,
     seed: int = 0,
+    **cfg_overrides,
 ) -> dict:
-    """One grid cell → row dict of mean±std ms for each phase."""
+    """One grid cell → row dict of mean±std ms for each phase.
+
+    ``cfg_overrides`` forward to the TransformerConfig — the knobs that
+    decide whether a big cell FITS one chip (``remat=True``,
+    ``scan_layers``, ``param_dtype="bfloat16"``); non-default values are
+    recorded as row columns so the artifact states what each number cost.
+    """
     cfg = config_for_size(
         size,
         vocab_size=vocab_size,
         context_length=context_length,
         compute_dtype=compute_dtype,
         attn_impl=attn_impl,
+        **cfg_overrides,
     )
     key = jax.random.PRNGKey(seed)
     params = init_transformer_lm(key, cfg)
@@ -106,6 +114,7 @@ def benchmark_lm_size(
         "dtype": compute_dtype,
         "attn": attn_impl,
         "jit": use_jit,
+        **cfg_overrides,
     }
     # Phases fail independently (OOM recorded per cell, like the reference's
     # benchmark_attention OOM-catch), ordered by working-set size so a model
@@ -195,6 +204,7 @@ def run_lm_benchmark(
     iters: int = 10,
     latex_path: str | None = None,
     oom_ok: bool = True,
+    **cfg_overrides,
 ):
     """Full grid → DataFrame. OOM cells become null rows (parity with the
     reference's OOM-catch, benchmark_attention.py:95-109)."""
@@ -215,6 +225,7 @@ def run_lm_benchmark(
                                 use_jit=use_jit,
                                 warmup=warmup,
                                 iters=iters,
+                                **cfg_overrides,
                             )
                         )
                     except Exception as e:  # OOM → null row
@@ -236,10 +247,27 @@ def main(argv=None) -> None:
     p.add_argument("--attn", nargs="+", default=["xla"],
                    choices=["xla", "flash", "flash_ref"])
     p.add_argument("--eager", action="store_true", help="also run un-jitted")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks in backward (activation "
+                        "memory ~ one layer; what makes 'large' train on "
+                        "one 16 GB chip)")
+    p.add_argument("--param-dtype", default=None,
+                   help="parameter/optimizer dtype (default float32; "
+                        "bfloat16 halves the resident p/m/v — lossy, "
+                        "recorded in the row)")
+    p.add_argument("--no-scan-layers", action="store_true",
+                   help="unroll the layer loop (faster at small depth)")
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--latex", default=None)
     args = p.parse_args(argv)
+    overrides = {}
+    if args.remat:
+        overrides["remat"] = True
+    if args.param_dtype:
+        overrides["param_dtype"] = args.param_dtype
+    if args.no_scan_layers:
+        overrides["scan_layers"] = False
     df = run_lm_benchmark(
         sizes=args.sizes,
         context_length=args.ctx,
@@ -250,6 +278,7 @@ def main(argv=None) -> None:
         warmup=args.warmup,
         iters=args.iters,
         latex_path=args.latex,
+        **overrides,
     )
     print_table(df)
 

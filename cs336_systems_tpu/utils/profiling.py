@@ -100,6 +100,42 @@ def memory_stats(device=None) -> dict:
     return {}
 
 
+def device_time_per_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
+    """Mean DEVICE-lane milliseconds per ``fn(*args)`` call, measured by
+    tracing ``iters`` dispatches and summing leaf-op durations.
+
+    This is the measurement CLAUDE.md mandates on remote-dispatch runtimes:
+    host wall clocks carry a ~230 ms dispatch+fence floor per call and
+    cannot resolve sub-ms kernels; device-lane op durations exclude both
+    dispatch gaps and host latency entirely. The fence is a real
+    ``device_get`` of one element (``block_until_ready`` has been observed
+    to return early here).
+    """
+    import tempfile
+
+    import numpy as np
+
+    def fence(out):
+        # fetch one element of EVERY leaf: dispatch is async on this
+        # runtime, so fencing only the first iteration's output would stop
+        # the trace while later dispatches are still queued — silently
+        # undercounting device time by up to (iters-1)/iters.
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+    out = fn(*args)  # compile
+    fence(out)
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    with tempfile.TemporaryDirectory() as td:
+        with trace(td, host_tracer_level=0):
+            outs = [fn(*args) for _ in range(iters)]
+            fence(outs)
+        _, total_ms = summarize_trace(td, top=1)
+    return total_ms / iters
+
+
 # ---------------------------------------------------------------------------
 # Trace analysis: device-time breakdown from a profiler trace
 #
