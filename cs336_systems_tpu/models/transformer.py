@@ -69,6 +69,17 @@ class TransformerConfig:
     # block explicitly. The "xla" impl needs neither.
     attn_batch_shard: str | None = None
     attn_head_shard: str | None = None
+    # Head-fold layout for the flash kernels' [rows, S, Dh] operands:
+    # "hb" (default) projects DIRECTLY into [H·B,S,Dh] via head-batched
+    # einsums ("bsd,hed->hbse") — the matmul writes the kernel's layout,
+    # so the S<->H transpose never exists; "bh" reshapes [B,S,H,Dh] ->
+    # transpose -> [B·H,S,Dh], which XLA materializes as operand-layout
+    # copies around the custom calls (measured +3.5% headline throughput
+    # for "hb", 123.5k -> 128.0k tok/s — BASELINE.md). Row order is
+    # irrelevant to the kernel (rows are independent). The GSPMD-sharded
+    # attention paths (tp/ep builders) use "bh" — their shard_map region
+    # is specced on the [B, H, S, Dh] axes.
+    attn_fold: str = "hb"
     # causal sliding-window attention: each query attends its last
     # `attn_window` positions (None = full causal). On the Pallas paths the
     # kernel grids are banded — cost scales with window, not context.
@@ -104,6 +115,15 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} > num_experts={self.num_experts}"
             )
+        if self.attn_fold not in ("bh", "hb"):
+            raise ValueError(f"unknown attn_fold: {self.attn_fold!r}")
+        if self.attn_fold == "hb" and (
+            self.attn_batch_shard or self.attn_head_shard
+        ):
+            raise ValueError(
+                "attn_fold='hb' is a single-device layout optimization; "
+                "the sharded attention paths use the 'bh' fold"
+            )
         if self.moe_dispatch not in ("dense", "sorted"):
             raise ValueError(f"unknown moe_dispatch: {self.moe_dispatch!r}")
         if self.moe_dp_axis is not None and self.moe_dispatch != "sorted":
@@ -131,6 +151,11 @@ class TransformerConfig:
     def from_dict(cls, d: dict) -> "TransformerConfig":
         names = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in names})
+
+
+# attn_impl values that dispatch to ops.flash_attention, and their impl=
+# argument — shared by _attention, _mha_hmajor, and the tp/ep builders.
+FLASH_IMPLS = {"flash": "pallas", "flash_ref": "reference", "flash_xla": "xla"}
 
 
 # Named sizes from the reference benchmark table (benchmark.py:247-259):
@@ -231,12 +256,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
             mask = causal_mask(q.shape[-2], k.shape[-2])
         out, _ = attention_with_lse(q, k, v, mask)
         return out
-    elif cfg.attn_impl in ("flash", "flash_ref", "flash_xla"):
+    elif cfg.attn_impl in FLASH_IMPLS:
         from cs336_systems_tpu.ops.flash_attention import flash_attention
 
-        impl = {"flash": "pallas", "flash_ref": "reference", "flash_xla": "xla"}[
-            cfg.attn_impl
-        ]
+        impl = FLASH_IMPLS[cfg.attn_impl]
 
         def local_attn(q, k, v):
             b, h, s, dh = q.shape
@@ -280,19 +303,61 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     raise ValueError(f"unknown attn_impl: {cfg.attn_impl}")
 
 
+def _mha_hmajor(p, x, cos, sin, positions, cfg: TransformerConfig):
+    """Head-major MHA: projections write the flash kernels' [H·B, S, Dh]
+    operand layout straight out of the matmul (cfg.attn_fold="hb").
+
+    The "bh" fold's [B,S,H,Dh] -> [B·H,S,Dh] rearrangement costs measured
+    Mosaic operand-layout copies around the Pallas custom calls (~14.5
+    ms/step of the 124 ms headline, BASELINE.md); batching the projection
+    einsum over the HEAD dim ("bsd,hed->hbse") makes the head dim the
+    matmul's leading batch dim, so the [H,B,S,Dh] output IS contiguous in
+    the folded layout and the transpose never exists. The kernels don't
+    care about row order (rows are independent (batch, head) pairs).
+    """
+    b, s, _ = x.shape
+    h, dh = cfg.num_heads, cfg.d_head
+    cdt = cfg.cdtype
+    from cs336_systems_tpu.ops.flash_attention import flash_attention
+
+    impl = FLASH_IMPLS[cfg.attn_impl]
+
+    def proj(wp):
+        w = wp["weight"].astype(cdt).reshape(h, dh, cfg.d_model)
+        out = jnp.einsum("bsd,hed->hbse", x.astype(cdt), w)
+        return out.reshape(h * b, s, dh)
+
+    with jax.named_scope("qkv_proj"):
+        q, k, v = proj(p["q_proj"]), proj(p["k_proj"]), proj(p["v_proj"])
+    with jax.named_scope("rope"):
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+    with jax.named_scope("sdpa"):
+        o = flash_attention(
+            q, k, v, causal=True, impl=impl, window=cfg.attn_window
+        )
+    with jax.named_scope("out_proj"):
+        wo = p["output_proj"]["weight"].astype(cdt).reshape(cfg.d_model, h, dh)
+        return jnp.einsum("hbse,ohe->bso", o.reshape(h, b, s, dh), wo)
+
+
 def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig,
          mesh=None):
     """Causal multi-head self-attention with RoPE on Q and K.
 
     Parity: CausalMultiHeadSelfAttention (model.py:435-524).
 
-    (A head-folded einsum formulation — ``bsd,hed->bhse`` emitting the
-    [B,H,S,Dh] layout straight from the projection matmul — was measured
-    perf-neutral on v5e: the ~14 ms/step of copies around attention are
-    Mosaic operand-layout copies, not these transposes. The plain form is
-    kept for bit-stable gradient reduction order across DP variants.)
+    Flash configs default to the head-MAJOR fold (``_mha_hmajor`` — the
+    projections write the kernels' [H·B, S, Dh] operand layout directly;
+    +3.5% headline, BASELINE.md). This plain [B,H,S,Dh] form remains the
+    path for the xla/ring impls and for the GSPMD-sharded attention
+    region, whose shard_map specs name the separate B and H axes. (A
+    b-major folded einsum ``bsd,hed->bhse`` was measured perf-neutral in
+    round 1 — only the h-major output is transpose-free.)
     """
     p = block_params
+    if cfg.attn_fold == "hb" and cfg.attn_impl in FLASH_IMPLS:
+        return _mha_hmajor(p, x, cos, sin, positions, cfg)
     b, s, _ = x.shape
     h, dh = cfg.num_heads, cfg.d_head
     split = lambda t: t.reshape(b, s, h, dh).transpose(0, 2, 1, 3)  # [B,H,S,Dh]

@@ -111,14 +111,19 @@ def make_ep_train_step(
 
     sh = functools.partial(named_sharding_tree, mesh)
 
-    if cfg.attn_impl in ("flash", "flash_ref", "flash_xla") and not (
+    from cs336_systems_tpu.models.transformer import FLASH_IMPLS
+
+    if cfg.attn_impl in FLASH_IMPLS and not (
         cfg.attn_batch_shard or cfg.attn_head_shard
     ) and have_dp:
         # same reasoning as make_tp_train_step: GSPMD cannot partition the
         # Pallas custom call, so pin the attention operands' batch sharding
         # and run the kernel in a shard_map over dp (heads replicated — EP
         # shards only the expert FFN weights).
-        cfg = dataclasses.replace(cfg, attn_batch_shard=dp_axis)
+        cfg = dataclasses.replace(
+            cfg, attn_batch_shard=dp_axis,
+            attn_fold="bh",  # the shard_map region specs [B, H, S, Dh] axes
+        )
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=cfg, mesh=mesh), hp, clip_norm,

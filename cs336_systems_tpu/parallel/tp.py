@@ -122,7 +122,9 @@ def make_tp_train_step(
 
     sh = functools.partial(named_sharding_tree, mesh)
 
-    if cfg.attn_impl in ("flash", "flash_ref", "flash_xla") and not (
+    from cs336_systems_tpu.models.transformer import FLASH_IMPLS
+
+    if cfg.attn_impl in FLASH_IMPLS and not (
         cfg.attn_batch_shard or cfg.attn_head_shard
     ):
         # The Pallas kernel is an opaque custom call GSPMD cannot partition;
@@ -133,6 +135,7 @@ def make_tp_train_step(
             cfg,
             attn_batch_shard=dp_axis if have_dp else None,
             attn_head_shard=tp_axis,
+            attn_fold="bh",  # the shard_map region specs [B, H, S, Dh] axes
         )
 
     step = make_update_fn(

@@ -266,6 +266,56 @@ def test_checkpoint_roundtrip(tmp_path):
     )
 
 
+def test_hmajor_fold_matches_default():
+    """attn_fold='hb' (head-major projections writing the kernel layout
+    directly) must be numerically equivalent to the default fold — fwd and
+    grads — including with a sliding window."""
+    import dataclasses
+
+    from cs336_systems_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer_lm,
+        transformer_lm,
+    )
+
+    for window in (None, 16):
+        # baseline must be the EXPLICIT "bh" fold — "hb" is the config
+        # default, so comparing against the default would compare a
+        # computation to itself
+        cfg = TransformerConfig(
+            vocab_size=64, context_length=64, d_model=64, num_layers=2,
+            num_heads=4, d_ff=128, attn_impl="flash_ref", attn_window=window,
+            attn_fold="bh",
+        )
+        cfg_hb = dataclasses.replace(cfg, attn_fold="hb")
+        params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+        x = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 64)
+
+        out = transformer_lm(params, x, cfg)
+        out_hb = transformer_lm(params, x, cfg_hb)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(out_hb), rtol=1e-4, atol=1e-5
+        )
+
+        def loss(p, c):
+            return jnp.sum(transformer_lm(p, x, c).astype(jnp.float32) ** 2)
+
+        g = jax.grad(lambda p: loss(p, cfg))(params)
+        g_hb = jax.grad(lambda p: loss(p, cfg_hb))(params)
+        from common import trees_allclose
+
+        assert trees_allclose(g_hb, g, rtol=1e-3, atol=1e-4), f"window={window}"
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="single-device"):
+        TransformerConfig(
+            vocab_size=64, context_length=64, d_model=64, num_layers=1,
+            num_heads=4, d_ff=128, attn_impl="flash", attn_fold="hb",
+            attn_head_shard="tp",
+        )
+
+
 def test_lm_attn_window_locality():
     """With attn_window=W, a token's logits must be invariant to input
     changes more than W positions back (and sensitive within the window)."""

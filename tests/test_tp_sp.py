@@ -308,7 +308,11 @@ def test_tp_train_step_flash_windowed():
 
 
 def test_flash_shard_declared_without_mesh_raises():
-    cfg = dataclasses.replace(CFG, attn_impl="flash", attn_head_shard="tp")
+    # attn_fold must be "bh" when shard axes are declared (the default
+    # "hb" fold is single-device and is rejected at config construction)
+    cfg = dataclasses.replace(
+        CFG, attn_impl="flash", attn_head_shard="tp", attn_fold="bh"
+    )
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
     x, _ = _data(jax.random.PRNGKey(3))
     with pytest.raises(ValueError, match="no mesh"):
