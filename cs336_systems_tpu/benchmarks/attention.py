@@ -172,8 +172,9 @@ def run_attention_benchmark(
 
 
 def plot_attention_benchmark(df, out_prefix: str = "attention_bench"):
-    """Latency-vs-seq and latency-vs-d figures (parity with
-    flashattentioncode.py:155-258). Requires matplotlib + pandas."""
+    """The reference's three figure families (flashattentioncode.py:155-258):
+    latency vs sequence length, latency vs head dim, and dtype bars (when
+    the frame holds more than one dtype). Requires matplotlib + pandas."""
     import matplotlib
 
     matplotlib.use("Agg")
@@ -181,6 +182,8 @@ def plot_attention_benchmark(df, out_prefix: str = "attention_bench"):
 
     ok = df[df.get("error").isna()] if "error" in df.columns else df
     for metric in ("forward_ms", "fwd_bwd_ms"):
+        if metric not in ok or ok[metric].dropna().empty:
+            continue  # every cell failed this phase: nothing to log-scale
         fig, ax = plt.subplots(figsize=(7, 4.5))
         for impl, grp in ok.groupby("impl"):
             g = grp.groupby("seq")[metric].mean()
@@ -193,6 +196,59 @@ def plot_attention_benchmark(df, out_prefix: str = "attention_bench"):
         ax.set_title(f"Attention {metric} vs sequence length")
         fig.tight_layout()
         fig.savefig(f"{out_prefix}_{metric}.png", dpi=120)
+        plt.close(fig)
+
+    # frames with no finite fwd+bwd cell at all (everything OOMed) can
+    # still reach here — skip the derived figures rather than crash after
+    # a long sweep
+    complete = ok.dropna(subset=["fwd_bwd_ms"]) if "fwd_bwd_ms" in ok else ok[0:0]
+
+    # latency vs head dim (at the largest seq every impl completed)
+    if ok["d"].nunique() > 1 and not complete.empty:
+        full_seqs = [
+            s for s, g in complete.groupby("seq")
+            if g["impl"].nunique() == complete["impl"].nunique()
+        ]
+        if full_seqs:  # impls may survive at disjoint seq sets
+            seq0 = max(full_seqs)
+            fig, ax = plt.subplots(figsize=(7, 4.5))
+            for impl, grp in complete[complete["seq"] == seq0].groupby("impl"):
+                g = grp.groupby("d")["fwd_bwd_ms"].mean()
+                ax.plot(g.index, g.values, marker="o", label=impl)
+            ax.set_xlabel("head dim")
+            ax.set_ylabel("fwd+bwd (ms)")
+            ax.legend()
+            ax.set_title(f"Attention fwd+bwd vs head dim (seq {seq0})")
+            fig.tight_layout()
+            fig.savefig(f"{out_prefix}_vs_d.png", dpi=120)
+            plt.close(fig)
+
+    # dtype bars (reference's fp32-vs-bf16 comparison)
+    if ok["dtype"].nunique() > 1 and not complete.empty:
+        import numpy as np
+
+        seqs = sorted(complete["seq"].unique())
+        impls = sorted(complete["impl"].unique())
+        dtypes = sorted(complete["dtype"].unique())
+        fig, axes = plt.subplots(
+            1, len(impls), figsize=(4.5 * len(impls), 4.0), squeeze=False
+        )
+        for ax, impl in zip(axes[0], impls):
+            width = 0.8 / len(dtypes)
+            for j, dt in enumerate(dtypes):
+                sel = complete[(complete["impl"] == impl)
+                               & (complete["dtype"] == dt)]
+                vals = [sel[sel["seq"] == s]["fwd_bwd_ms"].mean() for s in seqs]
+                ax.bar(np.arange(len(seqs)) + j * width, vals, width, label=dt)
+            ax.set_xticks(np.arange(len(seqs)) + 0.4 - width / 2)
+            ax.set_xticklabels([str(s) for s in seqs], rotation=45)
+            ax.set_yscale("log")
+            ax.set_title(impl)
+            ax.set_xlabel("seq")
+            ax.set_ylabel("fwd+bwd (ms)")
+            ax.legend()
+        fig.tight_layout()
+        fig.savefig(f"{out_prefix}_dtypes.png", dpi=120)
         plt.close(fig)
 
 
