@@ -215,3 +215,20 @@ def test_summarize_trace(tmp_path):
     assert total >= sum(r["total_ms"] for r in rows) - 1e-6
     # host python stack-frame lanes must not pollute the op rows
     assert not any(r["op"].startswith("$") for r in rows), rows[:5]
+
+
+def test_device_time_per_call():
+    """The trace-based per-call timer (the benchmark suites' 'device'
+    timing mode) returns a positive per-call millisecond figure and scales
+    its denominator by iters (same trace volume / more iters → smaller
+    per-call value or equal; exact ratios are backend-noise-bound, so only
+    sanity bounds are pinned)."""
+    from cs336_systems_tpu.utils.profiling import device_time_per_call
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((256, 256))
+    ms = device_time_per_call(f, x, iters=4, warmup=1)
+    assert 0.0 < ms < 10_000.0
