@@ -30,7 +30,11 @@ import jax
 import jax.numpy as jnp
 
 from cs336_systems_tpu.models.layers import apply_rope, embedding, linear, rmsnorm, rope_cache, swiglu
-from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    top_p_filter,
+    transformer_lm,
+)
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
@@ -202,27 +206,32 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     return logits, cache, plen
 
 
-def _sample(logits, key, temperature: float, top_k: int | None):
+def _sample(logits, key, temperature: float, top_k: int | None,
+            top_p: float | None = None):
     """Reference sampling semantics (model.py:292-303): temperature scale,
-    top-k threshold mask, categorical draw."""
+    top-k threshold mask, categorical draw — plus nucleus top-p filtering
+    (beyond parity; transformer.top_p_filter)."""
     logits = logits / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
-                   temperature, top_k):
+                   temperature, top_k, top_p=None):
     logits, cache, pos = prefill(params, prompt_ids, cfg)
 
     def step(carry, _):
         cache, pos, logits, key = carry
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, sub, temperature, top_k).astype(jnp.int32)
+        nxt = _sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
         new_logits, cache = decode_step(params, cache, pos, nxt, cfg)
         return (cache, pos + 1, new_logits, key), nxt
 
@@ -242,6 +251,7 @@ def generate_kv(
     temperature: float = 1.0,
     top_k: int | None = None,
     eos_token_id: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """KV-cached sampling — same contract as ``transformer.generate`` (the
     reference semantics) but one jit for the whole generation. 1-D prompt in
@@ -273,7 +283,8 @@ def generate_kv(
             "for sliding-window decoding"
         )
     tokens = _generate_scan(
-        params, ids, key, cfg, max_new_tokens, float(temperature), top_k
+        params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
+        top_p,
     )[0]
     if eos_token_id is not None:
         hits = jnp.where(tokens == eos_token_id)[0]
@@ -291,6 +302,7 @@ def generate_kv_batched(
     temperature: float = 1.0,
     top_k: int | None = None,
     eos_token_id: int | None = None,
+    top_p: float | None = None,
 ):
     """Batched KV-cached sampling: ``[B, P]`` prompts → one jit dispatch for
     the whole batch's generation. Decoding is matmul-starved at batch 1
@@ -311,7 +323,8 @@ def generate_kv_batched(
             f"exceeds context_length={cfg.context_length}"
         )
     tokens = _generate_scan(
-        params, ids, key, cfg, max_new_tokens, float(temperature), top_k
+        params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
+        top_p,
     )
     if eos_token_id is None:
         return tokens

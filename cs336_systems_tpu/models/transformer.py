@@ -495,6 +495,26 @@ def _forward_logits(params, ids, cfg: TransformerConfig):
     return transformer_lm(params, ids, cfg)
 
 
+def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering (beyond reference parity — the reference samples
+    with temperature/top-k only, model.py:292-303): keep the smallest set
+    of tokens whose probability mass reaches ``top_p``, masking the rest
+    to −inf. Operates on the last axis; jit-safe (sort-based, static
+    shapes). The most-probable token always survives (the nucleus is never
+    empty, even for top_p ≤ the max probability)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    order = jnp.argsort(probs, axis=-1)[..., ::-1]  # descending
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    # token i (in sorted order) is kept while the mass BEFORE it is < top_p;
+    # the argmax is force-kept so the nucleus is never empty (top_p <= 0
+    # would otherwise mask everything)
+    keep_sorted = ((csum - sorted_probs) < top_p).at[..., 0].set(True)
+    inv = jnp.argsort(order, axis=-1)  # sorted position of each vocab id
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
 def generate(
     params,
     cfg: TransformerConfig,
@@ -504,8 +524,10 @@ def generate(
     temperature: float = 1.0,
     top_k: int | None = None,
     eos_token_id: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
-    """Temperature + top-k sampling loop with EOS stop and context truncation.
+    """Temperature + top-k (and/or nucleus top-p) sampling loop with EOS
+    stop and context truncation.
 
     Like the reference, a full forward per token (no KV cache); prompts are
     right-padded to 64-token buckets so jit compiles once per bucket, not per
@@ -527,6 +549,8 @@ def generate(
         if top_k is not None:
             kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][-1]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            logits = top_p_filter(logits, top_p)
         key, sub = jax.random.split(key)
         nxt = int(jax.random.categorical(sub, logits))
         if eos_token_id is not None and nxt == eos_token_id:

@@ -101,6 +101,51 @@ def test_generate_kv_windowed_matches_uncached():
     assert not np.array_equal(np.asarray(got), np.asarray(full))
 
 
+def test_top_p_filter_nucleus_membership():
+    """Known distribution: probs [0.5, 0.3, 0.15, 0.05]. top_p=0.6 keeps
+    the smallest prefix reaching 0.6 -> {0, 1}; top_p=0.4 keeps {0}; a
+    tiny top_p still keeps the argmax (nucleus never empty). Batched rows
+    filter independently."""
+    from cs336_systems_tpu.models.transformer import top_p_filter
+
+    probs = jnp.asarray([0.5, 0.3, 0.15, 0.05])
+    logits = jnp.log(probs)
+
+    kept = np.isfinite(np.asarray(top_p_filter(logits, 0.6)))
+    np.testing.assert_array_equal(kept, [True, True, False, False])
+    kept = np.isfinite(np.asarray(top_p_filter(logits, 0.4)))
+    np.testing.assert_array_equal(kept, [True, False, False, False])
+    kept = np.isfinite(np.asarray(top_p_filter(logits, 1e-9)))
+    np.testing.assert_array_equal(kept, [True, False, False, False])
+    kept = np.isfinite(np.asarray(top_p_filter(logits, 1.0)))
+    np.testing.assert_array_equal(kept, [True, True, True, True])
+
+    batched = jnp.stack([logits, logits[::-1]])
+    kept = np.isfinite(np.asarray(top_p_filter(batched, 0.6)))
+    np.testing.assert_array_equal(kept[0], [True, True, False, False])
+    np.testing.assert_array_equal(kept[1], [False, False, True, True])
+
+
+def test_top_p_generate_kv_matches_uncached(params):
+    """Nucleus sampling through the KV-cache path == the uncached generate,
+    in a regime where top_p DECIDES the outcome: high temperature flattens
+    the distribution, and a tiny top_p forces the argmax — so a silently
+    dropped top_p in either path would sample near-uniformly and diverge
+    (and from the greedy reference)."""
+    prompt = [1, 2, 3]
+    kw = dict(max_new_tokens=8, temperature=2.0, top_p=1e-6)
+    key = jax.random.PRNGKey(13)
+    want = generate(params, CFG, prompt, key=key, **kw)
+    got = generate_kv(params, CFG, prompt, key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # the tiny-nucleus run must equal greedy decoding (argmax), which a
+    # missing filter at temperature 2.0 would not produce
+    greedy = generate_kv(params, CFG, prompt, key=key, max_new_tokens=8,
+                         temperature=1e-3, top_k=None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(greedy))
+
+
 def test_generate_kv_eos_truncation(params):
     prompt = [1, 2, 3]
     key = jax.random.PRNGKey(3)
