@@ -39,6 +39,36 @@ def _time_best(fn, reps: int = 3):
     return res.min_ms / 1e3, out
 
 
+# v5e HBM bandwidth (chip datasheet) and the measured dispatch+fence floor
+# of this runtime (CLAUDE.md) — used for the roofline columns.
+_HBM_GBPS = 819.0
+_DISPATCH_FLOOR_MS = 230.0
+
+
+def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> float:
+    """Analytic HBM-bound total milliseconds for the cached decode steps.
+
+    At serving time each decode step must read (a) every matmul weight once
+    (bf16 — the fp32→bf16 casts are loop-invariant and hoisted out of the
+    scan) and (b) the filled K/V cache prefix for every layer; writes and
+    activations are negligible. The attended prefix follows the
+    bucket-rounded fill schedule of models/decode._generate_scan.
+    """
+    from cs336_systems_tpu.models.decode import _ATTEND_BUCKET, _round_up
+
+    d, dff, L, v = cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.vocab_size
+    weight_bytes = (L * (4 * d * d + 3 * d * dff) + d * v) * 2  # bf16
+    alloc = min(_round_up(prompt_len + new_tokens, _ATTEND_BUCKET),
+                cfg.context_length)
+    h, dh = cfg.num_heads, cfg.d_head
+    total = 0.0
+    for i in range(new_tokens):
+        attend = min(_round_up(prompt_len + i + 1, _ATTEND_BUCKET), alloc)
+        cache_bytes = 2 * batch * h * attend * dh * 2 * L  # K+V, bf16
+        total += (weight_bytes + cache_bytes) / (_HBM_GBPS * 1e9)
+    return total * 1e3
+
+
 def benchmark_decode(
     size: str = "small",
     prompt_len: int = 64,
@@ -106,7 +136,11 @@ def benchmark_decode(
         }
     )
 
-    # batched serving throughput: same scan, B rows per dispatch
+    # batched serving throughput: same scan, B rows per dispatch. Roofline
+    # columns: analytic HBM-bound step time (weights + filled cache prefix
+    # per step — decode is bandwidth-bound) vs the estimated device time
+    # (total minus the runtime's ~230 ms dispatch floor; single-dispatch
+    # rows carry that constant, CLAUDE.md).
     for b in batch_sizes:
         prompts = jnp.tile(jnp.asarray([prompt], jnp.int32), (b, 1))
         dt_b, _ = _time_best(
@@ -116,6 +150,8 @@ def benchmark_decode(
             ),
             reps,
         )
+        roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
+        dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
         rows.append(
             {
                 "path": f"kv_cache_b{b}",
@@ -124,6 +160,9 @@ def benchmark_decode(
                 "total_ms": round(dt_b * 1e3, 1),
                 "tokens_per_s": round(b * new_tokens / dt_b, 1),
                 "ms_per_token": round(dt_b * 1e3 / (b * new_tokens), 3),
+                "roofline_ms": round(roof_ms, 1),
+                "device_est_ms": round(dev_ms, 1),
+                "roofline_frac": round(roof_ms / dev_ms, 2) if dev_ms > 0 else None,
             }
         )
 
@@ -153,7 +192,8 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--size", default="small")
     p.add_argument("--prompt", type=int, default=64)
-    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--new", nargs="*", type=int, default=[128],
+                   help="generation lengths to sweep")
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--batches", nargs="*", type=int, default=[],
                    help="also benchmark batched serving at these batch sizes")
@@ -162,11 +202,14 @@ def main(argv=None) -> None:
     p.add_argument("--latex", default=None)
     args = p.parse_args(argv)
 
-    rows = benchmark_decode(
-        size=args.size, prompt_len=args.prompt, new_tokens=args.new,
-        batch_sizes=tuple(args.batches), uncached=args.uncached,
-        reps=args.reps,
-    )
+    rows = []
+    for j, new in enumerate(args.new):
+        rows += benchmark_decode(
+            size=args.size, prompt_len=args.prompt, new_tokens=new,
+            batch_sizes=tuple(args.batches),
+            uncached=args.uncached and j == 0,  # the slow baseline once
+            reps=args.reps,
+        )
     df = results_table(rows, args.latex)
     print_table(df)
 

@@ -58,7 +58,8 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None
     }
 
 
-def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None):
+def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None,
+                      attend_len: int | None = None):
     """q: [B,H,1,Dh]; caches [B,H,S,Dh]; attend to positions <= pos.
 
     Delegates to the shared masked-softmax op (ops/attention.py) — the mask
@@ -66,9 +67,19 @@ def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None):
     window attention, transformer.TransformerConfig.attn_window) the mask
     additionally requires ``pos - j < window``, matching
     ``ops.attention.banded_causal_mask`` row ``pos`` so cached decoding
-    agrees with the uncached ``generate`` numerics."""
+    agrees with the uncached ``generate`` numerics.
+
+    ``attend_len``: STATIC upper bound on the filled length (caller
+    guarantees pos < attend_len) — the cache reads are sliced to the first
+    ``attend_len`` rows. Decode is HBM-bound (the K/V cache is the
+    dominant per-token traffic at serving batch sizes), so not touching
+    the unfilled tail is a bandwidth saving proportional to
+    (1 − fill/S_max), not a FLOP nicety."""
     from cs336_systems_tpu.ops.attention import attention_with_lse
 
+    if attend_len is not None and attend_len < k_cache.shape[-2]:
+        k_cache = k_cache[:, :, :attend_len]
+        v_cache = v_cache[:, :, :attend_len]
     s = k_cache.shape[-2]
     idx = jnp.arange(s)
     mask = idx <= pos
@@ -77,7 +88,8 @@ def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None):
     return attention_with_lse(q, k_cache, v_cache, mask[None, :])[0]
 
 
-def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
+def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig,
+                  attend_len: int | None = None):
     """One block on a single-token hidden state; returns (x, kc, vc)."""
     b = x.shape[0]
     h, dh = cfg.num_heads, cfg.d_head
@@ -93,7 +105,7 @@ def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig):
 
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
-    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window)
+    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window, attend_len)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
     x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
     x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
@@ -122,9 +134,14 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
     return swiglu(ffn_params, x, cfg.cdtype)
 
 
-def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig):
+def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
+                attend_len: int | None = None):
     """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
-    → (logits [B, vocab] fp32, updated cache)."""
+    → (logits [B, vocab] fp32, updated cache).
+
+    ``attend_len``: static bound on the filled cache length (pos <
+    attend_len); attention reads only that prefix — see
+    ``_cached_attention``."""
     pos = jnp.asarray(pos, jnp.int32)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
     x = embedding(params["token_embeddings"], token_ids[:, None], cfg.cdtype)
@@ -137,7 +154,8 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig):
     for l in range(cfg.num_layers):
         bp = jax.tree_util.tree_map(lambda a: a[l], params["blocks"])
         x, kc, vc = _decode_block(
-            bp, x, cache["k"][l], cache["v"][l], cos, sin, pos, cfg
+            bp, x, cache["k"][l], cache["v"][l], cos, sin, pos, cfg,
+            attend_len,
         )
         kcs.append(kc)
         vcs.append(vc)
@@ -220,25 +238,58 @@ def _sample(logits, key, temperature: float, top_k: int | None,
     return jax.random.categorical(key, logits, axis=-1)
 
 
+# The attended cache prefix grows in static buckets of this many rows:
+# within one bucket segment the decode scan attends a fixed-length slice,
+# and successive segments re-specialize the (tiny) step graph at the next
+# length. Keeps every shape static inside ONE jit while making per-token
+# HBM traffic scale with fill level instead of S_max.
+_ATTEND_BUCKET = 256
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                    temperature, top_k, top_p=None):
-    logits, cache, pos = prefill(params, prompt_ids, cfg)
+    plen = prompt_ids.shape[1]
+    total = plen + max_new_tokens
+    # Right-size the cache to this generation (bucket-rounded): decode is
+    # cache-bandwidth-bound, so allocating context_length rows and
+    # attending over them costs real ms/token when prompt+new << ctx.
+    alloc = min(_round_up(total, _ATTEND_BUCKET), cfg.context_length)
+    logits, cache, pos = prefill(params, prompt_ids, cfg, max_len=alloc)
 
-    def step(carry, _):
-        cache, pos, logits, key = carry
-        key, sub = jax.random.split(key)
-        nxt = _sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
-        new_logits, cache = decode_step(params, cache, pos, nxt, cfg)
-        return (cache, pos + 1, new_logits, key), nxt
+    def step(attend_len):
+        def body(carry, _):
+            cache, pos, logits, key = carry
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+            new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
+                                            attend_len)
+            return (cache, pos + 1, new_logits, key), nxt
 
-    (_, _, _, _), tokens = jax.lax.scan(
-        step, (cache, jnp.asarray(pos, jnp.int32), logits, key),
-        None, length=max_new_tokens,
-    )
+        return body
+
+    # Segment the generation so each scan attends a static bucket-rounded
+    # prefix: steps i in [i0, i1) write at pos plen+i and read rows
+    # [0, plen+i], so a segment may run while plen+i < attend_len.
+    carry = (cache, jnp.asarray(pos, jnp.int32), logits, key)
+    chunks = []
+    i = 0
+    while i < max_new_tokens:
+        attend_len = min(_round_up(plen + i + 1, _ATTEND_BUCKET), alloc)
+        seg = min(max_new_tokens - i, attend_len - plen - i)
+        carry, toks = jax.lax.scan(step(attend_len), carry, None, length=seg)
+        chunks.append(toks)
+        i += seg
+    if not chunks:  # max_new_tokens == 0: empty generation, as before
+        return jnp.zeros((prompt_ids.shape[0], 0), jnp.int32)
+    tokens = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
     return tokens.T  # [B, T]
 
 

@@ -80,6 +80,20 @@ class TransformerConfig:
     # attention paths (tp/ep builders) use "bh" — their shard_map region
     # is specced on the [B, H, S, Dh] axes.
     attn_fold: str = "hb"
+    # Fuse the RoPE rotation INTO the flash kernels (rope_cos/rope_sin
+    # operands; "hb" fold only): the qkv projections' output feeds the
+    # Pallas custom call directly, so the rope interleave's S-minor layout
+    # preference — the source of the last ~11.4 ms/step of operand-layout
+    # copies (BASELINE.md) — never exists in XLA-land. Numerically
+    # equivalent (equivalence-tested); gradients unchanged.
+    rope_fused: bool = True
+    # Project q/k/v with ONE stacked einsum "bsd,xhed->xhbse" instead of
+    # three (q/k/v become contiguous slices of its output). MEASURED
+    # NEGATIVE on v5e (BASELINE.md round 3): −12% alone, and it erodes the
+    # fused-rope win to +5% (the per-step weight stack + the [3,...] fusion
+    # output cost more than three direct matmuls). Kept for the record;
+    # default off.
+    qkv_fused: bool = False
     # causal sliding-window attention: each query attends its last
     # `attn_window` positions (None = full causal). On the Pallas paths the
     # kernel grids are banded — cost scales with window, not context.
@@ -315,6 +329,15 @@ def _mha_hmajor(p, x, cos, sin, positions, cfg: TransformerConfig):
     the folded layout and the transpose never exists. The kernels don't
     care about row order (rows are independent (batch, head) pairs).
     """
+    # apply_rope supports broadcastable [..., seq] positions, but under this
+    # fold the leading dim is the FOLDED [H·B] axis — per-batch [B, S]
+    # positions would mis-broadcast against it. Only shared-[S] positions
+    # are meaningful here; the "bh" path handles richer shapes.
+    if positions.ndim != 1:
+        raise ValueError(
+            "attn_fold='hb' requires shared 1-D positions [seq]; got shape "
+            f"{positions.shape} — use attn_fold='bh' for per-batch positions"
+        )
     b, s, _ = x.shape
     h, dh = cfg.num_heads, cfg.d_head
     cdt = cfg.cdtype
@@ -328,13 +351,33 @@ def _mha_hmajor(p, x, cos, sin, positions, cfg: TransformerConfig):
         return out.reshape(h * b, s, dh)
 
     with jax.named_scope("qkv_proj"):
-        q, k, v = proj(p["q_proj"]), proj(p["k_proj"]), proj(p["v_proj"])
-    with jax.named_scope("rope"):
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        if cfg.qkv_fused:
+            # one stacked matmul; q/k/v are contiguous slices of its output
+            w_all = jnp.stack([
+                p[n]["weight"].astype(cdt).reshape(h, dh, cfg.d_model)
+                for n in ("q_proj", "k_proj", "v_proj")
+            ])
+            qkv = jnp.einsum("bsd,xhed->xhbse", x.astype(cdt), w_all)
+            qkv = qkv.reshape(3, h * b, s, dh)
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            q, k, v = proj(p["q_proj"]), proj(p["k_proj"]), proj(p["v_proj"])
+    if cfg.rope_fused:
+        # rotation happens inside the kernels (see ops/flash_attention) —
+        # no rope op between the projections and the custom call
+        rope_kw = dict(
+            rope_cos=jnp.take(cos, positions, axis=0),
+            rope_sin=jnp.take(sin, positions, axis=0),
+        )
+    else:
+        with jax.named_scope("rope"):
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        rope_kw = {}
     with jax.named_scope("sdpa"):
         o = flash_attention(
-            q, k, v, causal=True, impl=impl, window=cfg.attn_window
+            q, k, v, causal=True, impl=impl, window=cfg.attn_window,
+            **rope_kw,
         )
     with jax.named_scope("out_proj"):
         wo = p["output_proj"]["weight"].astype(cdt).reshape(cfg.d_model, h, dh)

@@ -60,6 +60,78 @@ DEFAULT_Q_TILE = 512
 DEFAULT_K_TILE = 512
 _NEG_INF = -1e30  # finite fill: exp(_NEG_INF - m) == 0 without NaN risk
 
+# The kernels run the online softmax in BASE 2: scores are scaled by
+# log2(e)·scale once inside the MXU epilogue (folded into the existing
+# 1/sqrt(d) multiply), the running max / rescale / probabilities use
+# exp2, and the epilogue converts the logsumexp back to natural log
+# (lse = m·ln2 + log l). 2^x is the VPU's native exponential — e^x lowers
+# to 2^(x·log2e), an extra full-tile multiply per S×S score tile that this
+# reparameterization hoists into the scalar scale (the classic FA-2 trick).
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
+
+# ---------------------------------------------------------------------------
+# Fused RoPE (rotation applied to q/k tiles inside the kernels)
+#
+# Motivation (BASELINE.md): with rope applied between the qkv projections
+# and the Pallas custom calls, the interleave's S-minor layout preference
+# propagates into the projection fusion's output layout and XLA normalizes
+# to the custom call's row-major operand with ~11.4 ms/step of tile
+# transposes. Moving the rotation INSIDE the kernel removes rope from
+# XLA-land entirely, so the projection matmul writes the kernel operand
+# directly. In-kernel the interleaved-pair rotation
+#     out[2i]   = cos_i·x[2i]   − sin_i·x[2i+1]
+#     out[2i+1] = cos_i·x[2i+1] + sin_i·x[2i]
+# is expressed with full-width pair-duplicated tables as
+#     out = cos2 ∘ x + sin2 ∘ (x · R),        R[2i+1,2i] = −1, R[2i,2i+1] = 1
+# — an MXU matmul against a constant ±1 pair-swap matrix instead of a
+# stride-2 lane slice (which Mosaic would relayout). R is antisymmetric
+# and the rotation orthogonal, so the VJP is the rotation at −sin.
+
+
+def _rot_mat(d: int, dtype) -> jax.Array:
+    """The pair-swap matrix R: (x·R)[2i] = −x[2i+1], (x·R)[2i+1] = x[2i]."""
+    ji = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
+    plus = (ji + 1 == ii) & (ji % 2 == 0)   # R[2i, 2i+1] = +1
+    minus = (ji - 1 == ii) & (ji % 2 == 1)  # R[2i+1, 2i] = −1
+    return (plus.astype(jnp.float32) - minus.astype(jnp.float32)).astype(dtype)
+
+
+def _rope_rotate(x, cos2, sin2, inverse: bool = False):
+    """Rotate rows of ``x`` [..., n, d] by full-width tables [n, d] (fp32).
+
+    Products run in fp32 (x is upcast; the x·R dot is exact in any dtype —
+    R is ±1/0). ``inverse`` applies the transpose/inverse rotation (−sin):
+    the VJP of the forward rotation. Returns fp32.
+    """
+    xr = jax.lax.dot_general(
+        x, _rot_mat(x.shape[-1], x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    xf = x.astype(jnp.float32)
+    s = -sin2 if inverse else sin2
+    return cos2 * xf + s * xr
+
+
+def _expand_rope_tables(cos, sin):
+    """[n, d/2] half-width cos/sin → pair-duplicated [n, d] fp32 tables."""
+    expand = lambda t: jnp.repeat(t.astype(jnp.float32), 2, axis=-1)
+    return expand(cos), expand(sin)
+
+
+def _apply_rope_full(x, cos2, sin2, inverse: bool = False):
+    """XLA-land rotation by full-width tables (reference/xla/recompute
+    paths): same math as ``_rope_rotate`` without the matmul — the pair
+    swap is a reshape/stack, fused by XLA. Returns x.dtype."""
+    xf = x.astype(jnp.float32)
+    x2 = xf.reshape(xf.shape[:-1] + (xf.shape[-1] // 2, 2))
+    xr = jnp.stack([-x2[..., 1], x2[..., 0]], axis=-1).reshape(xf.shape)
+    s = -sin2 if inverse else sin2
+    return (cos2 * xf + s * xr).astype(x.dtype)
+
 
 def _pick_tile(n: int, want: int) -> int:
     """Largest power-of-two tile <= want that keeps one full tile <= n."""
@@ -93,7 +165,8 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
-                         window: int | None = None, q_off: int = 0):
+                         window: int | None = None, q_off: int = 0,
+                         rope=None):
     """Tiled online-softmax forward. q/k/v: [B, S, D] → (O [B,S,D], L [B,S]).
 
     The scan body is the same per-tile update as the reference inner loop
@@ -103,7 +176,13 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
     ``q_off``: static global offset of query row 0 relative to key row 0 —
     ring/sequence-parallel hops attend a K/V block that sits ``q_off``
     positions behind the local queries (parallel/ring.py).
+
+    ``rope``: optional (cos2, sin2) full-width tables — the fused-rope
+    contract; on this portable path the rotation simply runs in XLA first.
     """
+    if rope is not None:
+        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
+        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
     in_dtype = q.dtype
     b, n_q, d = q.shape
     n_k = k.shape[1]
@@ -175,10 +254,11 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
 # Pallas TPU kernel forward
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, n_k: int, bq: int, bk: int,
+def _flash_kernel(q_ref, k_ref, v_ref, *refs,
+                  scale: float, causal: bool, n_k: int, bq: int, bk: int,
                   n_k_tiles: int, window: int | None = None,
-                  banded: bool = False, q_off: int = 0):
+                  banded: bool = False, q_off: int = 0,
+                  has_rope: bool = False):
     """One (bh-group, q-tile, k-tile) grid step of the online-softmax forward.
 
     The k axis is the innermost grid dimension; Mosaic runs grid steps
@@ -191,7 +271,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     ~0.8 ms against ~0.26 ms of matmul; G=4 cut the forward ~35%). The
     folded [B·H, S, D] layout already has the group dim leading, which is
     exactly where Mosaic requires dot_general batch dims.
+
+    The softmax state (m, l) lives in BASE 2 (see ``_LOG2E``): scale folds
+    the log2(e) factor, probabilities/rescales use exp2, and the epilogue
+    emits the natural-log lse.
+
+    ``has_rope``: 4 extra operand blocks (cos/sin per-row tables for the
+    q and k tiles) precede the outputs; q/k are rotated in VMEM right
+    before the score dot, so XLA never sees rope (see module notes). On
+    multi-tile grids the q tile — loop-invariant across the inner k axis —
+    is rotated ONCE at kj==0 into a scratch; k is rotated per step (each k
+    step streams a new tile).
     """
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = refs[:4]
+        refs = refs[4:]
+    o_ref, lse_ref, m_ref, l_ref, acc_ref, *extra = refs
+    qrot_ref = extra[0] if extra else None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -200,6 +296,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
+        if qrot_ref is not None:
+            qrot_ref[:] = _rope_rotate(
+                q_ref[:], cq_ref[:][None], sq_ref[:][None]
+            ).astype(qrot_ref.dtype)
 
     q_start = qi * bq
     if banded:
@@ -220,17 +320,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             # tiles wholly left of the window contribute nothing
             needed = needed & (q_start + q_off - (k_start + bk - 1) < window)
 
-    @pl.when(needed)
-    def _compute():
+    def scores(apply_mask):
+        if has_rope:
+            q = (
+                qrot_ref[:]
+                if qrot_ref is not None
+                else _rope_rotate(
+                    q_ref[:], cq_ref[:][None], sq_ref[:][None]
+                ).astype(q_ref.dtype)
+            )
+            k = _rope_rotate(
+                k_ref[:], ck_ref[:][None], sk_ref[:][None]
+            ).astype(k_ref.dtype)
+        else:
+            q, k = q_ref[:], k_ref[:]
         s = (
             jax.lax.dot_general(
-                q_ref[:],
-                k_ref[:],
+                q,
+                k,
                 dimension_numbers=(((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )
-            * scale
+            * (scale * _LOG2E)  # base-2 exponent units
         )  # [G, bq, bk]
+        if not apply_mask:
+            return s
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = kpos < n_k  # K-padding mask
         if banded:
@@ -242,13 +356,46 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             valid = valid & (qpos >= kpos)
             if window is not None:
                 valid = valid & (qpos - kpos < window)
-        s = jnp.where(valid[None], s, _NEG_INF)
+        return jnp.where(valid[None], s, _NEG_INF)
 
+    if n_k_tiles == 1:
+        # SINGLE-K-TILE fast path (the headline S=512 shape): with one k
+        # step per q tile the online softmax degenerates to a plain
+        # softmax — no scratch init/read-modify-write, no running max, no
+        # alpha rescale, no m/l broadcast writes. Measured VPU savings at
+        # exactly the shape where the forward is furthest from its matmul
+        # roofline (BASELINE.md). Runs UNCONDITIONALLY (no `needed` skip):
+        # o/lse must always be written — an all-masked tile yields s =
+        # _NEG_INF everywhere, so the body itself emits the huge-negative
+        # lse discard marker the API contract promises.
+        def _single():
+            s = scores(apply_mask=causal or banded or n_k < bk)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp2(s - m)  # [G, bq, bk] fp32
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            safe_l = jnp.where(l > 0.0, l, 1.0)
+            o_ref[:] = (
+                jax.lax.dot_general(
+                    p.astype(v_ref.dtype),
+                    v_ref[:],
+                    dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                / safe_l
+            ).astype(o_ref.dtype)
+            lse_ref[:] = jnp.broadcast_to(
+                m * _LN2 + jnp.log(safe_l), lse_ref.shape
+            )
+
+        _single()
+        return
+
+    def update(s):
         m_prev = m_ref[:, :, 0:1]  # [G, bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [G, bq, 1]
-        p = jnp.exp(s - m_new)  # [G, bq, bk] fp32
+        alpha = jnp.exp2(m_prev - m_new)  # [G, bq, 1]
+        p = jnp.exp2(s - m_new)  # [G, bq, bk] fp32
         l_new = l_ref[:, :, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype),
@@ -258,6 +405,46 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # INTERIOR tiles — wholly inside the valid region (all kpos in range,
+    # strictly below the causal diagonal, inside the window's far edge) —
+    # skip the iota/compare/select masking entirely (the FA-2 trick: only
+    # diagonal-straddling tiles pay the mask). Interior/edge is a traced
+    # predicate, so both bodies exist in the kernel and one runs per step.
+    if causal or banded or window is not None:
+        interior = (k_start >= 0) & (k_start + bk <= n_k)
+        if causal:
+            interior = interior & (k_start + bk - 1 <= q_start + q_off)
+            if window is not None:
+                interior = interior & (
+                    q_start + q_off + bq - 1 - k_start < window
+                )
+
+        @pl.when(needed & interior)
+        def _compute_interior():
+            update(scores(apply_mask=False))
+
+        @pl.when(needed & jnp.logical_not(interior))
+        def _compute_edge():
+            update(scores(apply_mask=True))
+
+    elif n_k % bk == 0:
+        # non-causal, no K padding: no mask can ever bite
+        @pl.when(needed)
+        def _compute():
+            update(scores(apply_mask=False))
+
+    else:
+        # non-causal with K padding: only the last k tile needs the mask
+        interior = k_start + bk <= n_k
+
+        @pl.when(interior)
+        def _compute_interior():
+            update(scores(apply_mask=False))
+
+        @pl.when(jnp.logical_not(interior))
+        def _compute_edge():
+            update(scores(apply_mask=True))
 
     @pl.when(kj == n_k_tiles - 1)
     def _epilogue():
@@ -269,27 +456,36 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         # kernel's l/m residuals) — the host slices lane 0. A width-1 lse
         # output block is legal but measured ~5% slower end to end (narrow
         # strided HBM writes); the fat contiguous write wins.
+        # m is in base-2 units: natural lse = m·ln2 + log l.
         lse_ref[:] = jnp.broadcast_to(
-            m_ref[:, :, 0:1] + jnp.log(safe_l), lse_ref.shape
+            m_ref[:, :, 0:1] * _LN2 + jnp.log(safe_l), lse_ref.shape
         )
 
 
-def _pick_group(b: int, bq: int, bk: int, d: int, itemsize: int) -> int:
+def _pick_group(b: int, bq: int, bk: int, d: int, itemsize: int,
+                has_rope: bool = False) -> int:
     """Largest divisor of ``b`` whose per-grid-step VMEM footprint fits.
 
     Estimate per group row: s+p fp32 tiles (the dominant term), the
     double-buffered q/k/v/o blocks, the lse block, and the m/l/acc scratch.
     The 14 MB budget was calibrated on v5e (G=4 at bq=bk=512, d=64 bf16
     compiles and is the measured optimum; G=6 compiles but regresses, G=8
-    exceeds VMEM).
+    exceeds VMEM). Fused rope adds the 4 double-buffered fp32 table blocks
+    (group-shared: charged to the budget, not per row) and per-row fp32
+    rotation temporaries.
     """
+    budget = 14 * 1024 * 1024
     per_row = (
         2 * bq * bk * 4  # s, p fp32
         + 2 * 2 * (bq + bk) * d * itemsize  # q/o + k/v blocks, double-buffered
         + 2 * 2 * bq * 128 * 4  # lse block (double-buffered) + m/l scratch
         + bq * d * 4  # acc scratch
     )
-    g = max(1, min(b, (14 * 1024 * 1024) // per_row, 4))
+    if has_rope:
+        budget -= 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks, double-buffered
+        # fp32 rotation temporaries + the rotated-q VMEM stash
+        per_row += 2 * (bq + bk) * d * 4 + bq * d * itemsize
+    g = max(1, min(b, budget // per_row, 4))
     while b % g:
         g -= 1
     return g
@@ -306,7 +502,8 @@ def _gate_group(g: int, n_tiles: int, max_tiles: int) -> int:
 
 def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
                       interpret: bool | None = None,
-                      window: int | None = None, q_off: int = 0):
+                      window: int | None = None, q_off: int = 0,
+                      rope=None):
     """Host launch of the Pallas forward. q/k/v: [B, S, D] → (O, L).
 
     ``window`` (causal sliding window, in tokens) switches to a BANDED
@@ -344,7 +541,10 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
     else:
         n_kt = tk
         k_index = lambda bi, qi, kj: (bi, kj, 0)
-    g = _gate_group(_pick_group(b, bq, bk, d, qp.dtype.itemsize), n_kt, 16)
+    g = _gate_group(
+        _pick_group(b, bq, bk, d, qp.dtype.itemsize, has_rope=rope is not None),
+        n_kt, 16,
+    )
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -360,15 +560,27 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
         window=window,
         banded=banded,
         q_off=q_off,
+        has_rope=rope is not None,
     )
+    in_specs = [
+        pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
+        pl.BlockSpec((g, bk, d), k_index),
+        pl.BlockSpec((g, bk, d), k_index),
+    ]
+    operands = [qp, kp, vp]
+    if rope is not None:
+        # per-row cos/sin tables [S_pad, d], blocked by the q / k tile
+        # index (the k blocks reuse k_index so banded clamping matches)
+        cos2 = _pad_to(rope[0], 0, max(bq, bk))
+        sin2 = _pad_to(rope[1], 0, max(bq, bk))
+        q_tab = pl.BlockSpec((bq, d), lambda bi, qi, kj: (qi, 0))
+        k_tab = pl.BlockSpec((bk, d), lambda bi, qi, kj: k_index(bi, qi, kj)[1:])
+        in_specs += [q_tab, q_tab, k_tab, k_tab]
+        operands += [cos2, sin2, cos2, sin2]
     o, lse = pl.pallas_call(
         kernel,
         grid=(b // g, tq, n_kt),
-        in_specs=[
-            pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
-            pl.BlockSpec((g, bk, d), k_index),
-            pl.BlockSpec((g, bk, d), k_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
             pl.BlockSpec((g, bq, 128), lambda bi, qi, kj: (bi, qi, 0)),
@@ -381,9 +593,16 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
             pltpu.VMEM((g, bq, 128), jnp.float32),  # running max m
             pltpu.VMEM((g, bq, 128), jnp.float32),  # running denom l
             pltpu.VMEM((g, bq, d), jnp.float32),  # output accumulator
-        ],
+        ]
+        + (
+            # rotated-q stash: the q tile is invariant across the inner k
+            # axis — rotate once at kj==0, not once per k step
+            [pltpu.VMEM((g, bq, d), qp.dtype)]
+            if rope is not None and n_kt > 1
+            else []
+        ),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
     return o[:, :n_q], lse[:, :n_q, 0]
 
 
@@ -409,7 +628,7 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
     dS = P ∘ (dP − D) · scale. Returns (p fp32, ds in q.dtype)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
+    ) * (scale * _LOG2E)  # base-2 units (see _LOG2E)
     if causal:
         n_q, n_k = s.shape
         qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
@@ -418,7 +637,7 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
         if window is not None:
             keep = keep & (qpos - kpos < window)
         s = jnp.where(keep, s, _NEG_INF)
-    p = jnp.exp(s - lse)  # fp32; masked entries exp(-inf - lse) = 0
+    p = jnp.exp2(s - lse * _LOG2E)  # fp32; masked entries exp2(-inf) = 0
     dp = jax.lax.dot_general(
         do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -430,14 +649,23 @@ def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
                       scale: float, causal: bool,
                       window: int | None = None, q_off: int = 0,
-                      has_dlse: bool = False):
+                      has_dlse: bool = False, has_rope: bool = False):
     if has_dlse:
-        dlse_ref, dq_ref, dk_ref, dv_ref = rest
+        dlse_ref, *rest = rest
     else:
-        dq_ref, dk_ref, dv_ref = rest
         dlse_ref = None
-    q = q_ref[0]
-    k = k_ref[0]
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[:4]
+        rest = rest[4:]
+    dq_ref, dk_ref, dv_ref = rest
+    if has_rope:
+        # rotate q/k in VMEM (residuals are UNROTATED — the projections'
+        # direct output); gradients are un-rotated before the HBM write.
+        q = _rope_rotate(q_ref[0], cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
+        k = _rope_rotate(k_ref[0], ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
+    else:
+        q = q_ref[0]
+        k = k_ref[0]
     o = o_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [S, 1] column (host passes lse[..., None])
@@ -463,6 +691,10 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
     dk = jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if has_rope:
+        # VJP of the orthogonal rotation: rotate the cotangents at −sin
+        dq = _rope_rotate(dq, cq_ref[:], sq_ref[:], inverse=True)
+        dk = _rope_rotate(dk, ck_ref[:], sk_ref[:], inverse=True)
     dq_ref[0] = dq.astype(dq_ref.dtype)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -470,12 +702,14 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
                       interpret: bool | None = None,
-                      window: int | None = None, q_off: int = 0):
+                      window: int | None = None, q_off: int = 0,
+                      rope=None):
     """Fused backward: grid (batch·head,), whole sequence per step.
 
     ``dlse`` (the lse cotangent) may be None — the O-only differentiation
     path — in which case the kernel runs with the original operand set
-    (no extra column DMA)."""
+    (no extra column DMA). ``rope``: (cos2, sin2) full-width tables when
+    the forward fused the rotation (residual q/k are unrotated)."""
     b, n_q, d = q.shape
     n_k = k.shape[1]
     if interpret is None:
@@ -483,6 +717,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
     kernel = functools.partial(
         _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
         window=window, q_off=q_off, has_dlse=dlse is not None,
+        has_rope=rope is not None,
     )
     seq_spec = lambda s_len: pl.BlockSpec((1, s_len, d), lambda bi: (bi, 0, 0))
     # lse/dlse as [B, S, 1] columns: the minor block dim equals the full
@@ -497,6 +732,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
     if dlse is not None:
         in_specs.append(col_spec)
         operands.append(dlse[..., None])
+    if rope is not None:
+        tab = lambda rows: pl.BlockSpec((rows, d), lambda bi: (0, 0))
+        in_specs += [tab(n_q), tab(n_q), tab(n_k), tab(n_k)]
+        operands += [rope[0][:n_q], rope[1][:n_q], rope[0][:n_k], rope[1][:n_k]]
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(b,),
@@ -521,7 +760,7 @@ def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
     both [G, bq, bk]."""
     s = jax.lax.dot_general(
         q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-    ) * scale
+    ) * (scale * _LOG2E)  # base-2 units (see _LOG2E)
     if causal:
         n_q, n_k = s.shape[1], s.shape[2]
         qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
@@ -532,7 +771,7 @@ def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
         if window is not None:
             keep = keep & (qpos - kpos < window)
         s = jnp.where(keep[None], s, _NEG_INF)
-    p = jnp.exp(s - lse)  # fp32; masked entries exp(-inf - lse) = 0
+    p = jnp.exp2(s - lse * _LOG2E)  # fp32; masked entries exp2(-inf) = 0
     dp = jax.lax.dot_general(
         do.astype(v.dtype), v, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
@@ -541,16 +780,26 @@ def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
     return p, ds
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale: float, causal: bool, bq: int, bk: int,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale: float, causal: bool, bq: int, bk: int,
                     n_q_tiles: int, window: int | None = None,
                     banded: bool = False, n_q: int | None = None,
-                    q_off: int = 0):
+                    q_off: int = 0, has_rope: bool = False):
     """Pass 1 of the tiled backward: grid (bh-group, k-tile, q-tile), q
     innermost. VMEM scratch accumulates dK/dV for the current k-tiles across
     q-tiles; all tensors carry a leading G dim (see ``_flash_kernel`` — the
-    per-row grid is Mosaic step-overhead bound at 2 grid dims × many tiles)."""
+    per-row grid is Mosaic step-overhead bound at 2 grid dims × many tiles).
+
+    ``has_rope``: 4 extra per-row cos/sin table blocks follow delta; q/k
+    tiles are rotated in VMEM and the dK accumulator is un-rotated in the
+    epilogue (it accumulates w.r.t. the ROTATED k)."""
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[:4]
+        rest = rest[4:]
+        dk_ref, dv_ref, dk_acc, dv_acc, krot_ref = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        krot_ref = None
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -558,6 +807,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
+        if krot_ref is not None:
+            # k is invariant across the inner q axis: rotate once
+            krot_ref[:] = _rope_rotate(
+                k_ref[:], ck_ref[:][None], sk_ref[:][None]
+            ).astype(krot_ref.dtype)
 
     if banded:
         # a k-tile only receives gradient from q-tiles in
@@ -576,10 +830,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[:]
+        if has_rope:
+            q = _rope_rotate(
+                q_ref[:], cq_ref[:][None], sq_ref[:][None]
+            ).astype(q_ref.dtype)
+            k = krot_ref[:]
+        else:
+            q, k = q_ref[:], k_ref[:]
         do = do_ref[:].astype(jnp.float32)
         p, ds = _recompute_p_ds_grouped(
-            q, k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
+            q, k, v_ref[:], do, lse_ref[:], delta_ref[:],
             scale=scale, causal=causal, q_off=q_start + q_off, k_off=kj * bk,
             window=window, n_q_total=(n_q + q_off) if n_q is not None else None,
         )
@@ -594,23 +854,38 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == n_q_tiles - 1)
     def _epilogue():
-        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dk = dk_acc[:]
+        if has_rope:
+            dk = _rope_rotate(dk, ck_ref[:][None], sk_ref[:][None],
+                              inverse=True)
+        dk_ref[:] = dk.astype(dk_ref.dtype)
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc,
-                   *, scale: float, causal: bool, bq: int, bk: int,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale: float, causal: bool, bq: int, bk: int,
                    n_k_tiles: int, window: int | None = None,
                    banded: bool = False, n_k: int | None = None,
-                   q_off: int = 0):
+                   q_off: int = 0, has_rope: bool = False):
     """Pass 2: grid (bh-group, q-tile, k-tile), k innermost; accumulates dQ."""
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[:4]
+        rest = rest[4:]
+        dq_ref, dq_acc, qrot_ref = rest
+    else:
+        dq_ref, dq_acc = rest
+        qrot_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
     @pl.when(kj == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
+        if qrot_ref is not None:
+            # q is invariant across the inner k axis: rotate once
+            qrot_ref[:] = _rope_rotate(
+                q_ref[:], cq_ref[:][None], sq_ref[:][None]
+            ).astype(qrot_ref.dtype)
 
     if banded:
         k_tile_true = qi + q_off // bk - (n_k_tiles - 1) + kj
@@ -626,34 +901,51 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _compute():
+        if has_rope:
+            q = qrot_ref[:]
+            k = _rope_rotate(
+                k_ref[:], ck_ref[:][None], sk_ref[:][None]
+            ).astype(k_ref.dtype)
+        else:
+            q, k = q_ref[:], k_ref[:]
         do = do_ref[:].astype(jnp.float32)
         _, ds = _recompute_p_ds_grouped(
-            q_ref[:], k_ref[:], v_ref[:], do, lse_ref[:], delta_ref[:],
+            q, k, v_ref[:], do, lse_ref[:], delta_ref[:],
             scale=scale, causal=causal, q_off=qi * bq + q_off, k_off=k_start,
             window=window,
         )
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds, k_ref[:], (((2,), (1,)), ((0,), (0,))),
+            ds, k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == n_k_tiles - 1)
     def _epilogue():
-        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+        dq = dq_acc[:]
+        if has_rope:
+            dq = _rope_rotate(dq, cq_ref[:][None], sq_ref[:][None],
+                              inverse=True)
+        dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int) -> int:
+def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int,
+                          has_rope: bool = False) -> int:
     """Group size for the two-pass tiled backward kernels (same rationale as
     ``_pick_group``). Only applied at small tile counts — ``_gate_group``
     measured a ~20% win at tq=tk=4 (S=2048) but a wash from tk≈16 up, so
     very long sequences (S=65,536: tq=tk=128) intentionally run per-row."""
+    budget = 12 * 1024 * 1024
     per_row = (
         3 * bq * bk * 4  # s/p, dp fp32 tiles
         + bq * bk * itemsize  # ds in input dtype
         + 2 * 2 * (bq + bk) * d * itemsize  # q/do + k/v blocks, double-buffered
         + 2 * bk * d * 4  # dk/dv (or dq) accumulators
     )
-    g = max(1, min(b, (12 * 1024 * 1024) // per_row, 8))
+    if has_rope:
+        budget -= 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks (group-shared)
+        # fp32 rotation temporaries + the rotated-operand VMEM stash
+        per_row += 2 * (bq + bk) * d * 4 + max(bq, bk) * d * itemsize
+    g = max(1, min(b, budget // per_row, 8))
     while b % g:
         g -= 1
     return g
@@ -662,7 +954,8 @@ def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int) -> in
 def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
                             q_tile: int = 512, k_tile: int = 512,
                             interpret: bool | None = None,
-                            window: int | None = None, q_off: int = 0):
+                            window: int | None = None, q_off: int = 0,
+                            rope=None):
     """Tiled two-pass backward for long sequences: O(S) memory — no S×S
     tensor ever leaves VMEM. Recomputes P per tile from the saved
     logsumexp (the FlashAttention-2 backward schedule: a dK/dV pass over
@@ -699,7 +992,8 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
     n_kt_dq = n_w if banded else tk
     off_t = q_off // bk if banded else 0
     g = _gate_group(
-        _pick_group_tiled_bwd(b, bq, bk, d, q.dtype.itemsize),
+        _pick_group_tiled_bwd(b, bq, bk, d, q.dtype.itemsize,
+                              has_rope=rope is not None),
         max(n_qt, n_kt_dq), 8,
     )
     if banded:
@@ -711,19 +1005,28 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
     else:
         q_index = lambda bi, kj, qi: (bi, qi, 0)
 
+    dkv_in_specs = [
+        pl.BlockSpec((g, bq, d), q_index),                          # q
+        pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # k
+        pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # v
+        pl.BlockSpec((g, bq, d), q_index),                          # do
+        pl.BlockSpec((g, bq, 1), q_index),                          # lse
+        pl.BlockSpec((g, bq, 1), q_index),                          # delta
+    ]
+    dkv_operands = [q, k, v, do, lse_c, delta_c]
+    if rope is not None:
+        cos2, sin2 = rope[0][:n_q], rope[1][:n_q]  # n_q == n_k (gated)
+        q_tab = pl.BlockSpec((bq, d), lambda bi, kj, qi: q_index(bi, kj, qi)[1:])
+        k_tab = pl.BlockSpec((bk, d), lambda bi, kj, qi: (kj, 0))
+        dkv_in_specs += [q_tab, q_tab, k_tab, k_tab]
+        dkv_operands += [cos2, sin2, cos2, sin2]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_q_tiles=n_qt, window=window,
-                          banded=banded, n_q=n_q, q_off=q_off),
+                          banded=banded, n_q=n_q, q_off=q_off,
+                          has_rope=rope is not None),
         grid=(b // g, tk, n_qt),
-        in_specs=[
-            pl.BlockSpec((g, bq, d), q_index),                          # q
-            pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # k
-            pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),   # v
-            pl.BlockSpec((g, bq, d), q_index),                          # do
-            pl.BlockSpec((g, bq, 1), q_index),                          # lse
-            pl.BlockSpec((g, bq, 1), q_index),                          # delta
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
             pl.BlockSpec((g, bk, d), lambda bi, kj, qi: (bi, kj, 0)),
@@ -735,9 +1038,10 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
         scratch_shapes=[
             pltpu.VMEM((g, bk, d), jnp.float32),
             pltpu.VMEM((g, bk, d), jnp.float32),
-        ],
+        ]
+        + ([pltpu.VMEM((g, bk, d), k.dtype)] if rope is not None else []),
         **common,
-    )(q, k, v, do, lse_c, delta_c)
+    )(*dkv_operands)
 
     if banded:
         k_index = lambda bi, qi, kj: (
@@ -745,24 +1049,33 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
         )
     else:
         k_index = lambda bi, qi, kj: (bi, kj, 0)
+    dq_in_specs = [
+        pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # q
+        pl.BlockSpec((g, bk, d), k_index),                          # k
+        pl.BlockSpec((g, bk, d), k_index),                          # v
+        pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # do
+        pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # lse
+        pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # delta
+    ]
+    dq_operands = [q, k, v, do, lse_c, delta_c]
+    if rope is not None:
+        q_tab = pl.BlockSpec((bq, d), lambda bi, qi, kj: (qi, 0))
+        k_tab = pl.BlockSpec((bk, d), lambda bi, qi, kj: k_index(bi, qi, kj)[1:])
+        dq_in_specs += [q_tab, q_tab, k_tab, k_tab]
+        dq_operands += [cos2, sin2, cos2, sin2]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_k_tiles=n_kt_dq, window=window,
-                          banded=banded, n_k=n_k, q_off=q_off),
+                          banded=banded, n_k=n_k, q_off=q_off,
+                          has_rope=rope is not None),
         grid=(b // g, tq, n_kt_dq),
-        in_specs=[
-            pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # q
-            pl.BlockSpec((g, bk, d), k_index),                          # k
-            pl.BlockSpec((g, bk, d), k_index),                          # v
-            pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),   # do
-            pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # lse
-            pl.BlockSpec((g, bq, 1), lambda bi, qi, kj: (bi, qi, 0)),   # delta
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((g, bq, d), lambda bi, qi, kj: (bi, qi, 0)),
         out_shape=_out_sds(q.shape, q.dtype, q, k, v, do),
-        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)]
+        + ([pltpu.VMEM((g, bq, d), q.dtype)] if rope is not None else []),
         **common,
-    )(q, k, v, do, lse_c, delta_c)
+    )(*dq_operands)
     return dq, dk, dv
 
 
@@ -771,15 +1084,22 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
 
 
 def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
-                         window: int | None = None, q_off: int = 0):
+                         window: int | None = None, q_off: int = 0,
+                         rope=None):
     """Recompute-P backward (reference backward_pass_recomp,
     flash_attention.py:270-287), one fused XLA computation.
 
     P = exp(QKᵀ/√d − L); D = rowsum(O ∘ dO) − dL;
     dV = PᵀdO; dP = dO Vᵀ; dS = P ∘ (dP − D); dQ = dS K/√d; dK = dSᵀQ/√d.
     (The −dL term is the logsumexp output's cotangent: ∂L/∂S = P.)
+
+    ``rope``: fused-rope tables — rotate q/k here (XLA-land), un-rotate
+    dq/dk before returning (the rotation is orthogonal: VJP = −sin rotation).
     """
     in_dtype = q.dtype
+    if rope is not None:
+        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
+        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
@@ -808,6 +1128,13 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
                     preferred_element_type=jnp.float32) * scale
     dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32),
                     preferred_element_type=jnp.float32) * scale
+    if rope is not None:
+        dq = _apply_rope_full(
+            dq, rope[0][: q.shape[1]], rope[1][: q.shape[1]], inverse=True
+        )
+        dk = _apply_rope_full(
+            dk, rope[0][: k.shape[1]], rope[1][: k.shape[1]], inverse=True
+        )
     return dq.astype(in_dtype), dk.astype(in_dtype), dv.astype(in_dtype)
 
 
@@ -816,7 +1143,7 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
 
 
 def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None,
-                   q_off: int = 0):
+                   q_off: int = 0, rope=None):
     """Un-tiled fused forward for short sequences: one XLA einsum chain.
 
     Materializes the [B, n_q, n_k] score matrix *inside* the jit (fused, never
@@ -830,6 +1157,9 @@ def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None,
         causal_mask,
     )
 
+    if rope is not None:
+        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
+        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
     if causal and window is not None:
         mask = banded_causal_mask(q.shape[1], k.shape[1], window, q_off)
     elif causal:
@@ -839,7 +1169,7 @@ def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None,
     return attention_with_lse(q, k, v, mask)
 
 
-def _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window=None,
+def _flash_forward(q, k, v, rope, causal, impl, q_tile, k_tile, window=None,
                    q_off=0):
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
@@ -850,29 +1180,36 @@ def _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window=None,
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
     if impl == "pallas":
         return _flash_fwd_pallas(q, k, v, causal, q_tile, k_tile,
-                                 window=window, q_off=q_off)
+                                 window=window, q_off=q_off, rope=rope)
     elif impl == "reference":
         return _flash_fwd_reference(q, k, v, causal, q_tile, k_tile,
-                                    window=window, q_off=q_off)
+                                    window=window, q_off=q_off, rope=rope)
     elif impl == "xla":
-        return _flash_fwd_xla(q, k, v, causal, window=window, q_off=q_off)
+        return _flash_fwd_xla(q, k, v, causal, window=window, q_off=q_off,
+                              rope=rope)
     raise ValueError(f"unknown flash impl: {impl!r}")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, impl, q_tile, k_tile, window, q_off):
-    return _flash_forward(q, k, v, causal, impl, q_tile, k_tile, window, q_off)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, rope, causal, impl, q_tile, k_tile, window, q_off):
+    return _flash_forward(
+        q, k, v, rope, causal, impl, q_tile, k_tile, window, q_off
+    )
 
 
-def _flash_fwd_rule(q, k, v, causal, impl, q_tile, k_tile, window, q_off):
-    # symbolic_zeros=True wraps each primal in a CustomVJPPrimal
+def _flash_fwd_rule(q, k, v, rope, causal, impl, q_tile, k_tile, window,
+                    q_off):
+    # symbolic_zeros=True wraps each primal leaf in a CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
+    rope = jax.tree_util.tree_map(lambda p: p.value, rope)
     o, lse = _flash_forward(
-        q, k, v, causal, impl, q_tile, k_tile, window, q_off
+        q, k, v, rope, causal, impl, q_tile, k_tile, window, q_off
     )
     # Residuals mirror the reference contract: exactly (Q, K, V, O, L) with
     # L = logsumexp of shape [batch, n_queries] (flash_attention.py:66-70).
-    return (o, lse), (q, k, v, o, lse)
+    # Under fused rope, Q and K are the UNROTATED operands plus the tables
+    # — strictly less memory than saving rotated copies.
+    return (o, lse), (q, k, v, o, lse, rope)
 
 
 def _eligible_for_pallas_bwd(q, k, impl) -> bool:
@@ -913,7 +1250,10 @@ def _flash_bwd_rule(causal, impl, q_tile, k_tile, window, q_off, res,
                     cotangents):
     from jax.custom_derivatives import SymbolicZero
 
-    q, k, v, o, lse = res
+    q, k, v, o, lse, rope = res
+    # cos/sin tables are precomputed constants — their cotangents are
+    # discarded as zeros (nobody differentiates the rope cache).
+    drope = None if rope is None else tuple(jnp.zeros_like(t) for t in rope)
     # Both outputs are differentiable. The LSE cotangent folds into the
     # delta term of every backward: ∂L/∂S = P, so dS = P∘(dP − D + dL) —
     # i.e. D' = D − dL. Callers that use only O produce a SYMBOLIC zero
@@ -929,33 +1269,54 @@ def _flash_bwd_rule(causal, impl, q_tile, k_tile, window, q_off, res,
         do = jnp.zeros(o.shape, o.dtype)
     if _eligible_for_pallas_bwd(q, k, impl):
         # single fused kernel: whole sequence per grid step, least recompute
-        return _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal,
-                                 window=window, q_off=q_off)
-    if _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile):
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal,
+                                       window=window, q_off=q_off, rope=rope)
+    elif _eligible_for_tiled_bwd(q, k, impl, q_tile, k_tile):
         # two-pass tiled kernels: any length, O(S) memory (banded when
         # windowed — see _flash_fwd_pallas)
-        return _flash_bwd_pallas_tiled(
+        dq, dk, dv = _flash_bwd_pallas_tiled(
             q, k, v, o, lse, do, dlse, causal, q_tile=q_tile, k_tile=k_tile,
-            window=window, q_off=q_off,
+            window=window, q_off=q_off, rope=rope,
         )
-    return _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal,
-                                window=window, q_off=q_off)
+    else:
+        dq, dk, dv = _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal,
+                                          window=window, q_off=q_off,
+                                          rope=rope)
+    return dq, dk, dv, drope
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule, symbolic_zeros=True)
 
 
 def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None,
-                 q_off=0):
+                 q_off=0, rope_cos=None, rope_sin=None):
     """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash."""
+    rope = None
+    if rope_cos is not None:
+        if q.shape[-2] != k.shape[-2]:
+            raise ValueError(
+                "fused rope requires n_queries == n_keys (one per-row table "
+                f"serves both); got {q.shape[-2]} vs {k.shape[-2]}"
+            )
+        if q_off:
+            raise ValueError(
+                "fused rope requires q_pos_offset == 0 — ring hops rotate "
+                "before sharding (tables are indexed by LOCAL row)"
+            )
+        if rope_cos.shape[-1] * 2 != q.shape[-1]:
+            raise ValueError(
+                f"rope tables must be [n, d_head/2]; got {rope_cos.shape} "
+                f"for d_head {q.shape[-1]}"
+            )
+        rope = _expand_rope_tables(rope_cos, rope_sin)
     squeeze = q.ndim == 2
     if squeeze:
         q, k, v = q[None], k[None], v[None]
     lead = q.shape[:-2]
     fold = lambda x: x.reshape((-1,) + x.shape[-2:])
     o, lse = _flash(
-        fold(q), fold(k), fold(v), causal, impl, q_tile, k_tile, window,
-        q_off,
+        fold(q), fold(k), fold(v), rope, causal, impl, q_tile, k_tile,
+        window, q_off,
     )
     o = o.reshape(lead + o.shape[-2:])
     lse = lse.reshape(lead + lse.shape[-1:])
@@ -974,6 +1335,8 @@ def flash_attention(
     k_tile: int = DEFAULT_K_TILE,
     window: int | None = None,
     q_pos_offset: int = 0,
+    rope_cos: jax.Array | None = None,
+    rope_sin: jax.Array | None = None,
 ) -> jax.Array:
     """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
 
@@ -991,9 +1354,28 @@ def flash_attention(
     ``q_pos_offset``: static global position of query row 0 relative to key
     row 0 — sequence-parallel ring hops (parallel/ring.py) attend K/V blocks
     that sit whole shards behind the local queries.
+
+    All-masked-row contract: when masking (causal offset and/or window)
+    leaves a query row with NO valid key, that row's O values are
+    UNSPECIFIED (the safe-denominator epilogue emits finite garbage, not
+    NaN) and its gradient contribution is zero. This O-only API carries no
+    signal for such rows — callers that can produce them (ring's last
+    windowed hop) must use ``flash_attention_with_lse`` and discard rows by
+    their huge-negative lse marker (the -1e30 mask fill, times ln2 on the
+    base-2 Pallas path — test ``lse < -1e20``), as the online-softmax
+    merge does naturally (exp(lse − x) underflows to exactly 0).
+
+    ``rope_cos``/``rope_sin``: optional [n, d_head/2] per-row tables (the
+    rope cache gathered at the rows' positions, n >= S) — FUSES the
+    interleaved-pair RoPE rotation of q and k INSIDE the kernels, so the
+    projections' output feeds the custom call directly and no rope
+    interleave (or its layout preference) ever exists in XLA-land. Q and K
+    gradients are w.r.t. the UNROTATED inputs. Requires n_q == n_k and
+    q_pos_offset == 0 (tables are indexed by local row).
     """
     return _folded_call(
-        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset
+        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset,
+        rope_cos, rope_sin,
     )[0]
 
 
@@ -1007,13 +1389,26 @@ def flash_attention_with_lse(
     k_tile: int = DEFAULT_K_TILE,
     window: int | None = None,
     q_pos_offset: int = 0,
+    rope_cos: jax.Array | None = None,
+    rope_sin: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning (O, logsumexp [..., n_q] fp32) — the saved-residual
     contract (reference test digs L out of saved_tensors, test_attention.py:
     48-51). BOTH outputs are differentiable (the lse cotangent folds into
     the backward's delta term — see ``_flash_bwd_rule``), so downstream
     online-softmax merges of per-block results (ring attention) autodiff
-    exactly; accepts the same [..., S, D] shapes."""
+    exactly; accepts the same [..., S, D] shapes.
+
+    All-masked query rows (possible when ``q_pos_offset``/``window`` leave a
+    row no valid key) return UNSPECIFIED finite O values with a
+    huge-negative lse (the -1e30 mask fill; ×ln2 ≈ -6.9e29 on the base-2
+    Pallas path — test ``lse < -1e20``) — the lse is the discard signal:
+    any logaddexp merge weights such rows by exp(lse - x) = 0, and their
+    cotangents vanish with the weight.
+
+    ``rope_cos``/``rope_sin`` fuse RoPE into the kernels — see
+    ``flash_attention``."""
     return _folded_call(
-        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset
+        q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset,
+        rope_cos, rope_sin,
     )

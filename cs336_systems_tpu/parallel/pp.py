@@ -350,6 +350,13 @@ def make_pp_train_step(
             )
         fn = compiled.get(m)
         if fn is None:
+            # one-time visibility: auto selection prefers 2W (halves the
+            # GPipe bubble vs W) and changes step numerics vs an explicit
+            # --microbatches W run (microbatch loss-averaging order).
+            print(
+                f"[pp] auto-selected {m} microbatches "
+                f"(W={w}, per-device batch {b_dev})"
+            )
             fn = compiled[m] = build(m)
         return fn(params, opt_state, x, y)
 

@@ -109,6 +109,13 @@ def ring_attention_with_lse(
     o_acc, lse = attend(0, q, k, v)
     o_acc = o_acc.astype(jnp.float32)
 
+    # The hop loop is a Python unroll (not lax.scan) because q_pos_offset is
+    # a STATIC kernel parameter — each hop masks at a different global
+    # offset, so each needs its own pallas_call specialization. HLO size and
+    # compile time therefore grow linearly with the ring width; at pod-scale
+    # sp axes (dozens of hops) group hops that share a tile-aligned offset
+    # into a scanned inner loop, or cap the width with `window` (windowed
+    # rings truncate `hops` above and keep the unroll short).
     kb, vb = k, v
     for t in range(1, hops):
         kb = jax.lax.ppermute(kb, axis, perm)

@@ -511,18 +511,23 @@ def main(argv=None) -> None:
     from cs336_systems_tpu.data.loader import get_batch
 
     sharding = layer.batch_sharding
-    # Resume continues a fresh, step-seeded data stream (params/opt/step are
-    # exact; the original host-rng / sample-key positions are not persisted,
-    # so re-seeding by (seed, start_step) avoids REPEATING consumed data).
-    rng = np.random.default_rng([args.seed, start_step])
+    # The data stream is STEP-KEYED: every chunk derives its sampling state
+    # from (seed, first step of the chunk) rather than threading one stream
+    # forward. A resumed run therefore draws EXACTLY the batches the
+    # uninterrupted run would have drawn from that step on — combined with
+    # the bit-exact params/opt/step restore, the loss curve after --resume
+    # reproduces the uninterrupted curve number for number (proven in
+    # results/train_small_v5e.txt).
+    data_seed = jax.random.PRNGKey(args.seed)
+
+    def chunk_rng(step_no: int):
+        return np.random.default_rng([args.seed, step_no])
+
     if loop_chunk > 1:
         # device-resident corpus + in-jit sampling: zero per-step host
         # traffic (make_sampled_train_loop). Corpora beyond HBM should use
         # --loop-steps 1 to stream via the host get_batch path.
         corpus_dev = jax.device_put(np.asarray(corpus, np.int32))
-        sample_key = jax.random.fold_in(
-            jax.random.PRNGKey(args.seed), start_step
-        )
 
     eval_fn = None
     if args.eval_every:
@@ -558,12 +563,16 @@ def main(argv=None) -> None:
     while step_i < args.steps:
         chunk = min(loop_chunk, args.steps - step_i)
         if chunk == loop_chunk and loop_chunk > 1:
-            state, loss, sample_key = run(
-                state, corpus_dev, sample_key, args.batch
+            # step-keyed stream: the chunk's key depends only on
+            # (seed, step_i), so resume == uninterrupted (see above)
+            state, loss, _ = run(
+                state, corpus_dev,
+                jax.random.fold_in(data_seed, step_i), args.batch,
             )
         else:
             x, y = get_batch(
-                corpus, args.batch, args.ctx, rng=rng, sharding=sharding
+                corpus, args.batch, args.ctx, rng=chunk_rng(step_i),
+                sharding=sharding,
             )
             step_fn = run_one if (loop_chunk > 1 and run_one) else run
             state, loss = step_fn(state, x, y)

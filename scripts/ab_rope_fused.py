@@ -1,0 +1,122 @@
+"""Same-process A/B of the round-3 attention-path optimizations on the chip.
+
+Measures the headline train step (bench.py config) under the four
+combinations of {rope_fused, qkv_fused} — same process, same data, each
+best-of-3 — plus an on-chip numerics check of the fused-rope kernels
+(fwd + grads vs the rotate-outside formulation) and a compile probe of the
+fused single-pass backward at its S=1024 bf16 VMEM boundary with the rope
+operands added.
+
+BASELINE.md rule: isolated-kernel harness deltas do not transfer — only
+the end-to-end step decides. This script IS the end-to-end step.
+
+Usage: PYTHONPATH=. python scripts/ab_rope_fused.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.train import init_train_state, make_train_loop
+
+
+def measure(cfg, xs, ys, reps: int = 3) -> tuple[float, float]:
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))
+    params, opt_state, losses = loop(params, opt_state, xs, ys)
+    final_loss = float(losses[-1])  # fence + sanity value
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        params, opt_state, losses = loop(params, opt_state, xs, ys)
+        float(losses[-1])
+        dt = min(dt, time.perf_counter() - t0)
+    return dt, final_loss
+
+
+def main() -> None:
+    assert jax.default_backend() == "tpu", jax.default_backend()
+
+    # --- on-chip numerics: fused rope vs rotate-outside, headline shape ---
+    from cs336_systems_tpu.models.layers import apply_rope, rope_cache
+    from cs336_systems_tpu.ops.flash_attention import flash_attention
+
+    B, S, D = 384, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q, k, v = (jax.random.normal(kk, (B, S, D), jnp.bfloat16) for kk in ks[:3])
+    cos, sin = rope_cache(S, D)
+    pos = jnp.arange(S)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, impl="pallas",
+                            rope_cos=cos, rope_sin=sin).astype(jnp.float32) ** 2
+        )
+
+    def loss_outside(q, k, v):
+        qr = apply_rope(q, cos, sin, pos)
+        kr = apply_rope(k, cos, sin, pos)
+        return jnp.sum(
+            flash_attention(qr, kr, v, causal=True,
+                            impl="pallas").astype(jnp.float32) ** 2
+        )
+
+    gf = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+    go = jax.jit(jax.grad(loss_outside, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, go, "qkv"):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        ref = float(jnp.max(jnp.abs(b.astype(jnp.float32))))
+        print(f"on-chip d{name} max abs err {err:.4f} (ref magnitude {ref:.1f})",
+              flush=True)
+
+    # --- compile probe: fused single-pass bwd boundary S=1024 bf16 + rope ---
+    try:
+        q2, k2, v2 = (jax.random.normal(kk, (8, 1024, 64), jnp.bfloat16)
+                      for kk in ks[:3])
+        c2, s2 = rope_cache(1024, 64)
+        g2 = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, impl="pallas",
+                                rope_cos=c2, rope_sin=s2).astype(jnp.float32) ** 2
+            )
+        ))(q2, k2, v2)
+        jax.block_until_ready(g2)
+        print("S=1024 bf16 fused bwd + rope: compiles and runs", flush=True)
+    except Exception as e:  # noqa: BLE001 — report the Mosaic failure verbatim
+        print(f"S=1024 bf16 fused bwd + rope FAILED: {type(e).__name__}: "
+              f"{str(e)[:300]}", flush=True)
+
+    # --- end-to-end A/B ---
+    ctx, batch, timed = 512, 32, 10
+    base = config_for_size(
+        "small", context_length=ctx, compute_dtype="bfloat16",
+        attn_impl="flash", scan_layers=False,
+        rope_fused=False, qkv_fused=False,
+    )
+    xs = jax.random.randint(jax.random.PRNGKey(2), (timed, batch, ctx), 0,
+                            base.vocab_size)
+    ys = jnp.roll(xs, -1, axis=-1)
+
+    results = {}
+    for rf, qf in [(False, False), (True, False), (False, True), (True, True)]:
+        cfg = dataclasses.replace(base, rope_fused=rf, qkv_fused=qf)
+        dt, loss = measure(cfg, xs, ys)
+        toks = batch * ctx * timed / dt
+        results[(rf, qf)] = toks
+        print(f"rope_fused={rf!s:5} qkv_fused={qf!s:5}  "
+              f"{dt * 1e3 / timed:7.1f} ms/step  {toks:9.0f} tok/s  "
+              f"loss {loss:.4f}", flush=True)
+
+    base_t = results[(False, False)]
+    for kcfg, t in results.items():
+        print(f"{kcfg}: {t / base_t:+.1%} vs baseline", flush=True)
+
+
+if __name__ == "__main__":
+    main()

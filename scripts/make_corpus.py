@@ -1,0 +1,52 @@
+"""Build a byte-level training corpus from Python source text on disk.
+
+The environment has no network egress, so the proof-of-learning run
+(results/train_small_v5e.txt) trains on real text that ships with the
+image: the Python standard library's own source files. Tokens are raw
+bytes (ids 0-255), stored uint16 so the corpus drops straight into
+``train_cli --corpus`` with the flagship vocab (10k) unchanged — the
+model simply never sees ids >= 256.
+
+Usage: python scripts/make_corpus.py [--out /tmp/corpus.npy] [--mb 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="/tmp/corpus.npy")
+    p.add_argument("--mb", type=float, default=24.0,
+                   help="approximate corpus size in MB")
+    p.add_argument("--root", default=None,
+                   help="source tree to read (default: the running "
+                        "Python's stdlib directory)")
+    args = p.parse_args()
+
+    root = pathlib.Path(args.root or pathlib.Path(sys.modules["os"].__file__).parent)
+    budget = int(args.mb * 1e6)
+    chunks: list[bytes] = []
+    total = 0
+    for f in sorted(root.rglob("*.py")):
+        try:
+            data = f.read_bytes()
+        except OSError:
+            continue
+        chunks.append(data + b"\n\x00")  # NUL as document separator
+        total += len(data) + 2
+        if total >= budget:
+            break
+    corpus = np.frombuffer(b"".join(chunks), dtype=np.uint8).astype(np.uint16)
+    np.save(args.out, corpus)
+    print(f"{args.out}: {corpus.size:,} byte tokens from {len(chunks)} files "
+          f"under {root}")
+
+
+if __name__ == "__main__":
+    main()

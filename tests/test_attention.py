@@ -378,3 +378,161 @@ def test_window_requires_causal():
     q = jnp.ones((1, 8, 8))
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, q, q, causal=False, window=4)
+
+
+# ---------------------------------------------------------------------------
+# Fused RoPE (rotation inside the kernels — rope_cos/rope_sin operands)
+
+
+def _rope_oracle_attn(q, k, v, cos, sin, causal, window=None):
+    """Rotate-outside oracle: apply_rope in XLA, then plain attention."""
+    from cs336_systems_tpu.models.layers import apply_rope
+    from cs336_systems_tpu.ops.attention import banded_causal_mask
+
+    pos = jnp.arange(q.shape[-2])
+    qr = apply_rope(q, cos, sin, pos)
+    kr = apply_rope(k, cos, sin, pos)
+    if window is not None:
+        mask = banded_causal_mask(q.shape[-2], k.shape[-2], window)
+    elif causal:
+        mask = causal_mask(q.shape[-2], k.shape[-2])
+    else:
+        mask = None
+    return attention_with_lse(qr, kr, v, mask)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_rope_forward_matches_rotate_outside(impl, causal):
+    from cs336_systems_tpu.models.layers import rope_cache
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(20), 3, 256, 256, 64)
+    cos, sin = rope_cache(256, 64)
+    o_ref, lse_ref = _rope_oracle_attn(q, k, v, cos, sin, causal)
+    o, lse = flash_attention_with_lse(
+        q, k, v, causal=causal, impl=impl, q_tile=128, k_tile=128,
+        rope_cos=cos, rope_sin=sin,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref), rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_fused_rope_grads_are_wrt_unrotated_inputs(impl):
+    """Gradients through the fused-rope call must equal gradients through
+    the rotate-outside formulation — i.e. the kernel's inverse rotation of
+    the cotangents is the exact VJP of the in-kernel rotation."""
+    from cs336_systems_tpu.models.layers import rope_cache
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(21), 2, 128, 128, 64)
+    cos, sin = rope_cache(128, 64)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, impl=impl,
+                            rope_cos=cos, rope_sin=sin) ** 2
+        )
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(_rope_oracle_attn(q, k, v, cos, sin, True)[0] ** 2)
+
+    g = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-2, atol=1e-2,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+@pytest.mark.parametrize("kernel", ["fused", "tiled"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_rope_pallas_bwd_matches_recompute(kernel, causal):
+    """Both Pallas backwards (whole-seq fused and two-pass tiled) with rope
+    operands must equal the XLA recompute backward with the same rope
+    tables; interpret mode on CPU."""
+    from cs336_systems_tpu.models.layers import rope_cache
+    from cs336_systems_tpu.ops.flash_attention import (
+        _expand_rope_tables,
+        _flash_bwd_pallas,
+        _flash_bwd_pallas_tiled,
+        _flash_bwd_recompute,
+        _flash_fwd_reference,
+    )
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(22), 2, 256, 256, 64)
+    cos, sin = rope_cache(256, 64)
+    rope = _expand_rope_tables(cos, sin)
+    o, lse = _flash_fwd_reference(q, k, v, causal, 128, 128, rope=rope)
+    do = jax.random.normal(jax.random.PRNGKey(23), o.shape, o.dtype)
+    want = _flash_bwd_recompute(q, k, v, o, lse, do, None, causal, rope=rope)
+    if kernel == "fused":
+        got = _flash_bwd_pallas(q, k, v, o, lse, do, None, causal,
+                                interpret=True, rope=rope)
+    else:
+        got = _flash_bwd_pallas_tiled(q, k, v, o, lse, do, None, causal,
+                                      q_tile=128, k_tile=128,
+                                      interpret=True, rope=rope)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-3, atol=2e-3,
+            err_msg=f"d{name} (causal={causal})",
+        )
+
+
+def test_fused_rope_windowed_banded(impl="pallas"):
+    """Fused rope composes with the banded sliding-window grids (clamped
+    table fetches must be masked out exactly like the K/V fetches)."""
+    from cs336_systems_tpu.models.layers import rope_cache
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(24), 2, 512, 512, 64)
+    cos, sin = rope_cache(512, 64)
+    window = 100
+    o_ref, lse_ref = _rope_oracle_attn(q, k, v, cos, sin, True, window=window)
+    o, lse = flash_attention_with_lse(
+        q, k, v, causal=True, impl=impl, q_tile=64, k_tile=64,
+        window=window, rope_cos=cos, rope_sin=sin,
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref), rtol=1e-2, atol=1e-2)
+
+
+def test_fused_rope_model_equivalence():
+    """rope_fused / qkv_fused are pure layout optimizations: the LM forward
+    must be bitwise-close to the unfused config with identical params."""
+    import dataclasses
+
+    from cs336_systems_tpu.models.transformer import (
+        config_for_size,
+        init_transformer_lm,
+        transformer_lm,
+    )
+
+    cfg0 = config_for_size(
+        "small", context_length=128, num_layers=2, attn_impl="flash",
+        rope_fused=False, qkv_fused=False, scan_layers=False,
+    )
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg0)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, cfg0.vocab_size)
+    base = transformer_lm(params, ids, cfg0)
+    for rf, qf in [(True, False), (False, True), (True, True)]:
+        cfg = dataclasses.replace(cfg0, rope_fused=rf, qkv_fused=qf)
+        out = transformer_lm(params, ids, cfg)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(base), rtol=1e-4, atol=1e-4,
+            err_msg=f"rope_fused={rf} qkv_fused={qf}",
+        )
+
+
+def test_single_tile_all_masked_rows_emit_lse_marker():
+    """The single-k-tile fast path must still write O/lse when masking
+    leaves rows (or the whole tile) without valid keys — the huge-negative
+    lse is the documented discard marker (regression: an early version
+    skipped the body under `needed` and left the outputs unwritten)."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(30), 2, 128, 128, 64)
+    o, lse = flash_attention_with_lse(
+        q, k, v, causal=True, impl="pallas", q_tile=128, k_tile=128,
+        window=16, q_pos_offset=1024,  # every query far past every key
+    )
+    assert bool(jnp.all(lse < -1e20))
+    assert bool(jnp.all(jnp.isfinite(o)))

@@ -218,3 +218,19 @@ def test_generate_kv_batched_eos_and_validation(params):
         generate_kv_batched(
             params, CFG, jnp.zeros((2, 40), jnp.int32), 20, key
         )
+
+
+def test_generate_kv_zero_new_tokens():
+    """max_new_tokens=0 returns an empty generation (regression: the
+    bucket-segmented scan concatenated an empty chunk list)."""
+    from cs336_systems_tpu.models.decode import generate_kv
+    from cs336_systems_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer_lm,
+    )
+
+    cfg = TransformerConfig(vocab_size=32, context_length=64, d_model=64,
+                            num_layers=2, num_heads=4, d_ff=128)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    toks = generate_kv(params, cfg, [1, 2, 3], 0, jax.random.PRNGKey(1))
+    assert toks.shape == (0,)
