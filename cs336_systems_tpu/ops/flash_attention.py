@@ -20,10 +20,11 @@ Capability parity with the reference's three-part FlashAttention surface
   → THREE recompute backwards behind ``jax.custom_vjp``, all using the saved
   logsumexp (P = exp(S − L), D = rowsum(O ∘ dO), dV = PᵀdO,
   dS = P ∘ (dP − D), dQ = dS·K/√d, dK = dSᵀ·Q/√d; shared recompute core
-  ``_recompute_p_ds``), dispatched in ``_flash_bwd_rule``:
+  ``_recompute_p_ds_grouped``), dispatched in ``_flash_bwd_rule``:
   (a) ``_flash_bwd_pallas`` — fused single-pass Pallas kernel, grid over
-  (batch·head), whole sequence per step, every S×S intermediate in VMEM
-  only (TPU, pallas/auto impls, lane-aligned S up to the dtype-aware
+  (batch·head)/G groups of whole sequences per step (G>1 on bf16, VMEM-
+  picked), every S×S intermediate in VMEM only (TPU, pallas/auto impls,
+  lane-aligned S up to the dtype-aware
   ``_BWD_PALLAS_MAX_S_BF16``/``_F32`` VMEM bounds);
   (b) ``_flash_bwd_pallas_tiled`` — the FlashAttention-2 two-pass tiled
   schedule (dK/dV pass over k-tiles, dQ pass over q-tiles), O(S) memory at
@@ -367,7 +368,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs,
         # roofline (BASELINE.md). Runs UNCONDITIONALLY (no `needed` skip):
         # o/lse must always be written — an all-masked tile yields s =
         # _NEG_INF everywhere, so the body itself emits the huge-negative
-        # lse discard marker the API contract promises.
+        # lse discard marker the API contract promises. The guard is a
+        # TRACED always-true predicate, not a plain call: pl.when lowers
+        # to a cond whose vma rule unifies literal operands with the
+        # axis-varying blocks — required when the kernel runs in interpret
+        # mode inside a check_vma shard_map (ring/TP CPU tests); a direct
+        # call trips the strict vma equality check on literal*varying ops.
+        @pl.when(qi >= 0)
         def _single():
             s = scores(apply_mask=causal or banded or n_k < bk)
             m = jnp.max(s, axis=-1, keepdims=True)
@@ -387,7 +394,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs,
                 m * _LN2 + jnp.log(safe_l), lse_ref.shape
             )
 
-        _single()
         return
 
     def update(s):
@@ -609,41 +615,17 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
 # ---------------------------------------------------------------------------
 # Backward: fused Pallas kernel (moderate S) or XLA recompute (fallback)
 
-# One whole-sequence tile per (batch·head) grid step keeps every
-# intermediate in VMEM, so the backward touches HBM only for
-# q/k/v/o/do/dq/dk/dv. Live S×S tensors: s/p (fp32), dp (fp32), pb/ds
-# (input dtype) — ~14 MB at S=1024 bf16, ~24 MB at S=1024 fp32; the fp32
-# case exceeds v5e VMEM (Mosaic compile failure, verified on chip), so the
-# bound is dtype-aware. Both bounds verified on chip up to d_head=128 (the
-# S×S terms dominate; d only adds the [S, d] operand blocks). Beyond the
-# bound the tiled two-pass kernels take over (O(tile²) VMEM, any length).
+# G whole-sequence rows per grid step (G=1 at the VMEM bound; >1 on bf16
+# when the picker allows) keep every intermediate in VMEM, so the backward
+# touches HBM only for q/k/v/o/do/dq/dk/dv. Live S×S tensors PER ROW:
+# s/p (fp32), dp (fp32), pb/ds (input dtype) — ~14 MB at S=1024 bf16,
+# ~24 MB at S=1024 fp32; the fp32 case exceeds v5e VMEM (Mosaic compile
+# failure, verified on chip), so the bound is dtype-aware. Both bounds
+# verified on chip up to d_head=128 (the S×S terms dominate; d only adds
+# the [S, d] operand blocks). Beyond the bound the tiled two-pass kernels
+# take over (O(tile²) VMEM, any length).
 _BWD_PALLAS_MAX_S_BF16 = 1024
 _BWD_PALLAS_MAX_S_F32 = 512
-
-
-def _recompute_p_ds(q, k, v, do, lse, delta, *, scale: float, causal: bool,
-                    q_off, k_off, window: int | None = None):
-    """Shared recompute core of every Pallas backward kernel: scaled QKᵀ,
-    causal mask at global offsets, P = exp(S − L), dP = dO·Vᵀ,
-    dS = P ∘ (dP − D) · scale. Returns (p fp32, ds in q.dtype)."""
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * (scale * _LOG2E)  # base-2 units (see _LOG2E)
-    if causal:
-        n_q, n_k = s.shape
-        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
-        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
-        keep = (qpos >= kpos) & (kpos >= 0)
-        if window is not None:
-            keep = keep & (qpos - kpos < window)
-        s = jnp.where(keep, s, _NEG_INF)
-    p = jnp.exp2(s - lse * _LOG2E)  # fp32; masked entries exp2(-inf) = 0
-    dp = jax.lax.dot_general(
-        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = (p * (dp - delta) * scale).astype(q.dtype)
-    return p, ds
 
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
@@ -661,14 +643,14 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
     if has_rope:
         # rotate q/k in VMEM (residuals are UNROTATED — the projections'
         # direct output); gradients are un-rotated before the HBM write.
-        q = _rope_rotate(q_ref[0], cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
-        k = _rope_rotate(k_ref[0], ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
+        q = _rope_rotate(q_ref[:], cq_ref[:][None], sq_ref[:][None]).astype(q_ref.dtype)
+        k = _rope_rotate(k_ref[:], ck_ref[:][None], sk_ref[:][None]).astype(k_ref.dtype)
     else:
-        q = q_ref[0]
-        k = k_ref[0]
-    o = o_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # [S, 1] column (host passes lse[..., None])
+        q = q_ref[:]
+        k = k_ref[:]
+    o = o_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]  # [G, S, 1] columns (host passes lse[..., None])
     # D' = rowsum(O ∘ dO) − dL: the lse cotangent folds into delta because
     # ∂L/∂S = P — so dS gains +P·dL, i.e. delta -= dlse. The dlse operand
     # exists only when the caller actually differentiates through the lse
@@ -676,54 +658,75 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
     # keeps the original operand set and its measured throughput.
     delta = jnp.sum(o * do, axis=-1, keepdims=True)
     if dlse_ref is not None:
-        delta = delta - dlse_ref[0]
+        delta = delta - dlse_ref[:]
 
-    p, ds = _recompute_p_ds(q, k, v_ref[0], do, lse, delta,
-                            scale=scale, causal=causal, q_off=q_off, k_off=0,
-                            window=window)
+    # Whole sequences, G (batch·head) rows per grid step via dots batched
+    # over the leading block dim — same rationale as the forward grouping:
+    # per-row grids pay ~2 us/step of Mosaic overhead, which at S=512
+    # bf16 is a large fraction of the per-row compute.
+    p, ds = _recompute_p_ds_grouped(
+        q, k, v_ref[:], do, lse, delta,
+        scale=scale, causal=causal, q_off=q_off, k_off=0, window=window,
+    )
     dv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), do.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        p.astype(v_ref.dtype), do.astype(v_ref.dtype),
+        (((1,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32,
     )
     dq = jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds, k, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
     )
     dk = jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds, q, (((1,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
     )
     if has_rope:
         # VJP of the orthogonal rotation: rotate the cotangents at −sin
-        dq = _rope_rotate(dq, cq_ref[:], sq_ref[:], inverse=True)
-        dk = _rope_rotate(dk, ck_ref[:], sk_ref[:], inverse=True)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        dq = _rope_rotate(dq, cq_ref[:][None], sq_ref[:][None], inverse=True)
+        dk = _rope_rotate(dk, ck_ref[:][None], sk_ref[:][None], inverse=True)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
                       interpret: bool | None = None,
                       window: int | None = None, q_off: int = 0,
                       rope=None):
-    """Fused backward: grid (batch·head,), whole sequence per step.
+    """Fused backward: grid (batch·head // G,), G whole sequences per step.
 
     ``dlse`` (the lse cotangent) may be None — the O-only differentiation
     path — in which case the kernel runs with the original operand set
     (no extra column DMA). ``rope``: (cos2, sin2) full-width tables when
-    the forward fused the rotation (residual q/k are unrotated)."""
+    the forward fused the rotation (residual q/k are unrotated).
+
+    G (the batch-row group per grid step) follows the tiled-backward VMEM
+    picker with the whole sequence as the tile. Measured on v5e (round-3
+    A/B at the headline S=512 bf16 shape, G=2): a wash on the isolated
+    kernel (1.03 vs ~1.04 ms at 384 rows — compute-bound, consistent with
+    the round-2 finding) with the end-to-end step trending ~1-2% faster;
+    kept because it also unifies the recompute core with the tiled
+    kernels. fp32 stays PER-ROW: its S×S intermediates are 2× bf16's and
+    G=2 at the S=512 fp32 eligibility bound lands on the documented VMEM
+    edge (see _BWD_PALLAS_MAX_S_F32) — only the bf16 grouping is
+    chip-validated."""
     b, n_q, d = q.shape
     n_k = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if q.dtype == jnp.bfloat16:
+        g = _pick_group_tiled_bwd(b, n_q, n_k, d, q.dtype.itemsize,
+                                  has_rope=rope is not None)
+    else:
+        g = 1
     kernel = functools.partial(
         _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
         window=window, q_off=q_off, has_dlse=dlse is not None,
         has_rope=rope is not None,
     )
-    seq_spec = lambda s_len: pl.BlockSpec((1, s_len, d), lambda bi: (bi, 0, 0))
+    seq_spec = lambda s_len: pl.BlockSpec((g, s_len, d), lambda bi: (bi, 0, 0))
     # lse/dlse as [B, S, 1] columns: the minor block dim equals the full
     # array dim (Mosaic-legal), they land in VMEM already sublane-major —
     # no 128× broadcast materialization, no in-kernel relayout.
-    col_spec = pl.BlockSpec((1, n_q, 1), lambda bi: (bi, 0, 0))
+    col_spec = pl.BlockSpec((g, n_q, 1), lambda bi: (bi, 0, 0))
     in_specs = [
         seq_spec(n_q), seq_spec(n_k), seq_spec(n_k), seq_spec(n_q),
         col_spec, seq_spec(n_q),
@@ -738,7 +741,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
         operands += [rope[0][:n_q], rope[1][:n_q], rope[0][:n_k], rope[1][:n_k]]
     dq, dk, dv = pl.pallas_call(
         kernel,
-        grid=(b,),
+        grid=(b // g,),
         in_specs=in_specs,
         out_specs=[seq_spec(n_q), seq_spec(n_k), seq_spec(n_k)],
         out_shape=[
@@ -754,10 +757,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
 def _recompute_p_ds_grouped(q, k, v, do, lse, delta, *, scale: float,
                             causal: bool, q_off, k_off,
                             window: int | None = None, n_q_total=None):
-    """Grouped recompute core: operands carry a leading G (batch-row) dim;
-    dots are batched over it (Mosaic requires batch dims at position 0).
-    Same math as ``_recompute_p_ds``. Returns (p fp32, ds in q.dtype),
-    both [G, bq, bk]."""
+    """The recompute core shared by every Pallas backward kernel: scaled
+    QKᵀ, causal/window mask at global offsets, P = exp2(S − L·log2e),
+    dP = dO·Vᵀ, dS = P ∘ (dP − D) · scale. Operands carry a leading G
+    (batch-row) dim; dots are batched over it (Mosaic requires batch dims
+    at position 0). Returns (p fp32, ds in q.dtype), both [G, bq, bk]."""
     s = jax.lax.dot_general(
         q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
     ) * (scale * _LOG2E)  # base-2 units (see _LOG2E)
