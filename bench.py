@@ -59,11 +59,14 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     ctx = 512
-    batch = 32 if on_tpu else 2
+    batch = 48 if on_tpu else 2
     # Measured on v5e (BASELINE.md): the Pallas kernels (512-tile forward +
     # fused single-pass backward, S×S only ever in VMEM) beat the fused-XLA
     # attention end to end, and the unrolled layer loop beats lax.scan (no
-    # activation-stash copies). Batch 32 is the measured throughput peak.
+    # activation-stash copies). Batch 48 is the round-3 throughput peak
+    # (scripts/ab_batch.py: 24/32/40/48/64 -> 137.9/143.8/148.4/151.9/147.4k
+    # tok/s same-process — the rope-fused kernels freed enough step time
+    # that the peak moved up from round 2's batch 32).
     cfg = config_for_size(
         "small",
         context_length=ctx,

@@ -491,7 +491,11 @@ def _pick_group(b: int, bq: int, bk: int, d: int, itemsize: int,
         budget -= 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks, double-buffered
         # fp32 rotation temporaries + the rotated-q VMEM stash
         per_row += 2 * (bq + bk) * d * 4 + bq * d * itemsize
-    g = max(1, min(b, budget // per_row, 4))
+    # fp32 with a tiny head dim (d=16) at G=4 crashes the Mosaic compiler
+    # (remote tpu_compile_helper exit 1; bisected on chip: g<=2 compiles,
+    # bf16 g=4 compiles, fp32 d>=32 g=4 compiles). Cap the narrow case.
+    cap = 2 if itemsize == 4 and d < 32 else 4
+    g = max(1, min(b, budget // per_row, cap))
     while b % g:
         g -= 1
     return g

@@ -1,0 +1,62 @@
+"""Same-process batch-size sweep of the headline train step.
+
+Round-2 measured batch 32 as the tokens/sec peak for the headline config.
+The round-3 kernels changed the step's composition (fused rope removed
+most layout copies; the single-tile forward cut VPU work), so the peak is
+re-measured here: each batch gets its own jitted 10-step loop, same
+process, best-of-3, tokens/sec compared directly.
+
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/ab_batch.py [batches...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.train import init_train_state, make_train_loop
+
+
+def main() -> None:
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    batches = [int(a) for a in sys.argv[1:]] or [24, 32, 40, 48, 64]
+    ctx, timed = 512, 10
+    cfg = config_for_size(
+        "small", context_length=ctx, compute_dtype="bfloat16",
+        attn_impl="flash", scan_layers=False,
+    )
+    hp = AdamWHparams(lr=3e-4)
+    for batch in batches:
+        params, opt_state = init_train_state(jax.random.PRNGKey(0), cfg)
+        loop = make_train_loop(cfg, hp)
+        xs = jax.random.randint(
+            jax.random.PRNGKey(1), (timed, batch, ctx), 0, cfg.vocab_size
+        )
+        ys = jnp.roll(xs, -1, axis=-1)
+        try:
+            params, opt_state, losses = loop(params, opt_state, xs, ys)
+            float(losses[-1])
+            dt = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                params, opt_state, losses = loop(params, opt_state, xs, ys)
+                float(losses[-1])
+                dt = min(dt, time.perf_counter() - t0)
+            toks = batch * ctx * timed / dt
+            print(f"batch {batch:4d}  {dt * 1e3 / timed:7.1f} ms/step  "
+                  f"{toks:9.0f} tok/s", flush=True)
+        except Exception as e:  # noqa: BLE001 — record over-HBM cells
+            print(f"batch {batch:4d}  FAILED: {type(e).__name__}: "
+                  f"{str(e)[:160]}", flush=True)
+        finally:
+            del params, opt_state
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

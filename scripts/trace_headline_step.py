@@ -28,7 +28,7 @@ def main() -> None:
     logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/headline_trace"
     on_tpu = jax.default_backend() == "tpu"
     steps = 10 if on_tpu else 2
-    batch = 32 if on_tpu else 2
+    batch = 48 if on_tpu else 2  # keep in lockstep with bench.py (the headline peak)
     cfg = config_for_size(
         "small",
         context_length=512,

@@ -536,3 +536,17 @@ def test_single_tile_all_masked_rows_emit_lse_marker():
     )
     assert bool(jnp.all(lse < -1e20))
     assert bool(jnp.all(jnp.isfinite(o)))
+
+
+def test_pick_group_caps_fp32_narrow_head():
+    """Pin the on-chip-bisected Mosaic compiler boundary: fp32 with
+    d_head < 32 crashes the TPU compiler at forward group G=4 (g<=2,
+    bf16 g=4, and fp32 d>=32 g=4 all compile) — _pick_group must cap
+    that case. CI cannot reproduce the crash (it is a TPU-compiler
+    subprocess failure), so the picker's clamp is the tested contract."""
+    from cs336_systems_tpu.ops.flash_attention import _pick_group
+
+    # small tiles so the VMEM budget is not the binding constraint
+    assert _pick_group(8, 128, 128, 16, 4) <= 2   # fp32, d=16: capped
+    assert _pick_group(8, 128, 128, 16, 2) == 4   # bf16, d=16: uncapped
+    assert _pick_group(8, 128, 128, 64, 4) == 4   # fp32, d=64: uncapped
