@@ -234,3 +234,37 @@ def test_generate_kv_zero_new_tokens():
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
     toks = generate_kv(params, cfg, [1, 2, 3], 0, jax.random.PRNGKey(1))
     assert toks.shape == (0,)
+
+
+def test_generate_kv_crosses_attend_bucket_boundary():
+    """The bucket-grown attended prefix (decode._ATTEND_BUCKET segments)
+    must be numerically invisible: a generation whose fill crosses a
+    segment boundary must match the uncached loop token for token. Uses a
+    context larger than one bucket so the scan really re-specializes
+    mid-generation (prompt 200 + 100 new crosses the 256-row boundary)."""
+    import dataclasses
+
+    from cs336_systems_tpu.models import decode as decode_mod
+
+    cfg = dataclasses.replace(CFG, context_length=512)
+    params = init_transformer_lm(jax.random.PRNGKey(3), cfg)
+    prompt = list(range(1, 201))
+    # sanity: the segment plan really splits at the 256-row bucket
+    plen, new = 200, 100
+    bounds = []
+    i = 0
+    while i < new:
+        attend = min(
+            decode_mod._round_up(plen + i + 1, decode_mod._ATTEND_BUCKET),
+            decode_mod._round_up(plen + new, decode_mod._ATTEND_BUCKET),
+        )
+        seg = min(new - i, attend - plen - i)
+        bounds.append((attend, seg))
+        i += seg
+    assert len(bounds) == 2 and bounds[0][0] == 256 and bounds[1][0] == 512
+
+    kw = dict(max_new_tokens=new, temperature=0.05, top_k=8)
+    key = jax.random.PRNGKey(11)
+    want = generate(params, cfg, prompt, key=key, **kw)
+    got = generate_kv(params, cfg, prompt, key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
