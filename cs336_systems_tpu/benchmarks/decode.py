@@ -89,8 +89,10 @@ def benchmark_decode(
         size,
         context_length=max(512, prompt_len + new_tokens),
         compute_dtype="bfloat16" if on_tpu else "float32",
-        # decode attends through the masked-softmax op, not the Pallas
-        # kernel (single-row queries); xla is the right impl either way
+        # cfg.attn_impl only steers the TRAINING/prefill attention op; the
+        # per-token decode attention has its own dispatch (generate_kv's
+        # attn_impl arg, default "auto" = the fused Pallas decode kernel
+        # on TPU, masked-softmax elsewhere — models/decode._cached_attention)
         attn_impl="xla",
     )
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)

@@ -59,13 +59,19 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None
 
 
 def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None,
-                      attend_len: int | None = None):
+                      attend_len: int | None = None, impl: str = "auto"):
     """q: [B,H,1,Dh]; caches [B,H,S,Dh]; attend to positions <= pos.
 
-    Delegates to the shared masked-softmax op (ops/attention.py) — the mask
-    [1, S] selects the filled cache prefix. With ``window`` set (sliding-
-    window attention, transformer.TransformerConfig.attn_window) the mask
-    additionally requires ``pos - j < window``, matching
+    ``impl="pallas"`` (the "auto" choice on TPU) runs the fused decode
+    kernel (ops/decode_attention.py): scores, mask, softmax, and the
+    weighted-V reduction in VMEM with each cache slab streamed once —
+    the XLA masked-softmax lowering measured ~3.4x off the cache-read
+    roofline at serving batch (trace attribution in the kernel's module
+    docstring). ``impl="xla"`` delegates to the shared masked-softmax op
+    (ops/attention.py) — the mask [1, S] selects the filled cache prefix.
+    Both paths: with ``window`` set (sliding-window attention,
+    transformer.TransformerConfig.attn_window) the mask additionally
+    requires ``pos - j < window``, matching
     ``ops.attention.banded_causal_mask`` row ``pos`` so cached decoding
     agrees with the uncached ``generate`` numerics.
 
@@ -75,21 +81,38 @@ def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None,
     dominant per-token traffic at serving batch sizes), so not touching
     the unfilled tail is a bandwidth saving proportional to
     (1 − fill/S_max), not a FLOP nicety."""
-    from cs336_systems_tpu.ops.attention import attention_with_lse
-
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"unknown decode attention impl: {impl!r} (want 'auto', "
+            "'pallas' or 'xla' — this is the serving-kernel choice, not "
+            "TransformerConfig.attn_impl)"
+        )
     if attend_len is not None and attend_len < k_cache.shape[-2]:
         k_cache = k_cache[:, :, :attend_len]
         v_cache = v_cache[:, :, :attend_len]
+    if impl == "auto":
+        from cs336_systems_tpu.ops import decode_attention as da
+
+        fits = da.supported(
+            k_cache.shape[-2], k_cache.shape[-1], k_cache.dtype.itemsize
+        )
+        impl = "pallas" if fits and jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        from cs336_systems_tpu.ops.decode_attention import decode_attention
+
+        return decode_attention(q, k_cache, v_cache, pos, window=window)
     s = k_cache.shape[-2]
     idx = jnp.arange(s)
     mask = idx <= pos
     if window is not None:
         mask &= pos - idx < window
+    from cs336_systems_tpu.ops.attention import attention_with_lse
+
     return attention_with_lse(q, k_cache, v_cache, mask[None, :])[0]
 
 
 def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig,
-                  attend_len: int | None = None):
+                  attend_len: int | None = None, attn_impl: str = "auto"):
     """One block on a single-token hidden state; returns (x, kc, vc)."""
     b = x.shape[0]
     h, dh = cfg.num_heads, cfg.d_head
@@ -105,7 +128,8 @@ def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig,
 
     kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
     vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
-    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window, attend_len)
+    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window, attend_len,
+                             attn_impl)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
     x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
     x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
@@ -135,27 +159,35 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
 
 
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
-                attend_len: int | None = None):
+                attend_len: int | None = None, attn_impl: str = "auto"):
     """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
     → (logits [B, vocab] fp32, updated cache).
 
     ``attend_len``: static bound on the filled cache length (pos <
     attend_len); attention reads only that prefix — see
-    ``_cached_attention``."""
+    ``_cached_attention``. ``params["blocks"]`` may be the stacked
+    [L, ...]-leaf pytree (the training layout) or a tuple of per-layer
+    pytrees (``unstack_blocks``) — inside the generation scan the caller
+    unstacks ONCE so the per-layer slices are loop-invariant; left stacked,
+    XLA re-materializes every block's weight slices each token (~141
+    slice DMAs/token traced at b32, scripts/trace_decode_step.py)."""
     pos = jnp.asarray(pos, jnp.int32)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
     x = embedding(params["token_embeddings"], token_ids[:, None], cfg.cdtype)
 
     # Unrolled layer loop over per-layer cache leaves (see init_kv_cache):
-    # static slices of the stacked block params fold into their consuming
-    # matmuls (same finding as the training path's unrolled layers), and
     # each layer's one-column cache update aliases in place.
+    blocks = params["blocks"]
+    stacked = not isinstance(blocks, (tuple, list))
     kcs, vcs = [], []
     for l in range(cfg.num_layers):
-        bp = jax.tree_util.tree_map(lambda a: a[l], params["blocks"])
+        bp = (
+            jax.tree_util.tree_map(lambda a: a[l], blocks) if stacked
+            else blocks[l]
+        )
         x, kc, vc = _decode_block(
             bp, x, cache["k"][l], cache["v"][l], cos, sin, pos, cfg,
-            attend_len,
+            attend_len, attn_impl,
         )
         kcs.append(kc)
         vcs.append(vc)
@@ -224,14 +256,46 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     return logits, cache, plen
 
 
+def unstack_blocks(params):
+    """Stacked [L, ...]-leaf block params → a tuple of per-layer pytrees.
+
+    Done ONCE outside the decode scan so the per-layer weight slices are
+    loop-invariant: left inside the scan body, XLA declines to hoist them
+    (traced ~141 slice DMAs/token at b32 — every block leaf re-sliced per
+    token, ~131 us/token of pure DMA)."""
+    blocks = params["blocks"]
+    if isinstance(blocks, (tuple, list)):
+        return params
+    n = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    out = dict(params)
+    out["blocks"] = tuple(
+        jax.tree_util.tree_map(lambda a: a[l], blocks) for l in range(n)
+    )
+    return out
+
+
 def _sample(logits, key, temperature: float, top_k: int | None,
-            top_p: float | None = None):
+            top_p: float | None = None, approx_top_k: bool = False):
     """Reference sampling semantics (model.py:292-303): temperature scale,
     top-k threshold mask, categorical draw — plus nucleus top-p filtering
-    (beyond parity; transformer.top_p_filter)."""
+    (beyond parity; transformer.top_p_filter).
+
+    ``approx_top_k``: compute the top-k threshold with the TPU-native
+    partial reduction (``jax.lax.approx_max_k``) instead of exact top-k —
+    the exact form lowers to a full vocab sort (traced: 293 us/token at
+    b32, 14% of decode device time; approx measured 14 us on chip, 19x).
+    The approximate set can MISS true top-k elements (recall ~0.95), so
+    its minimum — the threshold — sits at or BELOW the exact k-th logit:
+    the mask then retains the full exact candidate set plus at most a few
+    extra tail candidates (a superset; slightly more diversity, never
+    less). Off by default (exact reference semantics)."""
     logits = logits / temperature
     if top_k is not None:
-        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        k = min(top_k, logits.shape[-1])
+        if approx_top_k:
+            kth = jax.lax.approx_max_k(logits, k)[0][..., -1:]
+        else:
+            kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         logits = top_p_filter(logits, top_p)
@@ -252,10 +316,12 @@ def _round_up(n: int, m: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p"),
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p",
+                     "attn_impl", "approx_top_k"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
-                   temperature, top_k, top_p=None):
+                   temperature, top_k, top_p=None, attn_impl="auto",
+                   approx_top_k=False):
     plen = prompt_ids.shape[1]
     total = plen + max_new_tokens
     # Right-size the cache to this generation (bucket-rounded): decode is
@@ -263,14 +329,16 @@ def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
     # attending over them costs real ms/token when prompt+new << ctx.
     alloc = min(_round_up(total, _ATTEND_BUCKET), cfg.context_length)
     logits, cache, pos = prefill(params, prompt_ids, cfg, max_len=alloc)
+    params = unstack_blocks(params)  # loop-invariant per-layer slices
 
     def step(attend_len):
         def body(carry, _):
             cache, pos, logits, key = carry
             key, sub = jax.random.split(key)
-            nxt = _sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+            nxt = _sample(logits, sub, temperature, top_k, top_p,
+                          approx_top_k).astype(jnp.int32)
             new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
-                                            attend_len)
+                                            attend_len, attn_impl)
             return (cache, pos + 1, new_logits, key), nxt
 
         return body
@@ -303,10 +371,17 @@ def generate_kv(
     top_k: int | None = None,
     eos_token_id: int | None = None,
     top_p: float | None = None,
+    attn_impl: str = "auto",
+    approx_top_k: bool = False,
 ) -> jax.Array:
     """KV-cached sampling — same contract as ``transformer.generate`` (the
     reference semantics) but one jit for the whole generation. 1-D prompt in
     → 1-D tokens out, truncated at EOS on the host.
+
+    ``attn_impl``: cached-attention kernel ("auto" = the fused Pallas
+    decode kernel on TPU, masked-softmax XLA elsewhere — see
+    ``_cached_attention``). ``approx_top_k``: TPU-native approximate top-k
+    threshold instead of the full-sort exact form (see ``_sample``).
 
     Note: prompt + max_new_tokens must fit the context window (the cache is
     the window); the uncached ``generate`` additionally supports sliding-
@@ -335,7 +410,7 @@ def generate_kv(
         )
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
-        top_p,
+        top_p, attn_impl, approx_top_k,
     )[0]
     if eos_token_id is not None:
         hits = jnp.where(tokens == eos_token_id)[0]
@@ -354,6 +429,8 @@ def generate_kv_batched(
     top_k: int | None = None,
     eos_token_id: int | None = None,
     top_p: float | None = None,
+    attn_impl: str = "auto",
+    approx_top_k: bool = False,
 ):
     """Batched KV-cached sampling: ``[B, P]`` prompts → one jit dispatch for
     the whole batch's generation. Decoding is matmul-starved at batch 1
@@ -375,7 +452,7 @@ def generate_kv_batched(
         )
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
-        top_p,
+        top_p, attn_impl, approx_top_k,
     )
     if eos_token_id is None:
         return tokens

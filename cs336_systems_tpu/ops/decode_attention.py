@@ -1,0 +1,159 @@
+"""Fused decode-attention kernel: one cached-attention row per (batch, head).
+
+Serving-path counterpart of ops/flash_attention.py. At decode, attention is
+a matvec per (batch, head) — q is ONE row against the filled K/V cache
+prefix — and the cost is pure HBM bandwidth: read the caches once. XLA
+lowers the masked-softmax formulation (ops/attention.py via
+models/decode._cached_attention) to per-layer ``multiply_reduce`` fusions
+that measured ~3.4x off the cache-read roofline on v5e (the [.., 1, S] x
+[.., S, 64] matvec reads the 64-wide minor dim at half lane occupancy, and
+the softmax runs as separate fusions over re-read score rows —
+scripts/trace_decode_step.py attributes 1256 of 2064 us/token to them at
+b32).
+
+This kernel streams each (batch-head group)'s K and V slabs through VMEM
+exactly once per token: scores, the causal/window mask at the traced fill
+position, the softmax, and the weighted-V reduction all happen in VMEM
+between the two DMAs. No online-softmax state is needed — the whole filled
+prefix (bucket-rounded by the caller, models/decode._ATTEND_BUCKET) fits
+VMEM per group, so this is the single-tile fast path of the flash forward
+with a runtime (SMEM) mask position instead of a static grid offset.
+
+The matvecs run on the MXU as batched dots in the flash kernels'
+known-good [G, bq, bk] shape, with q broadcast to bq=8 identical sublane
+rows IN XLA (the 8x extra MXU flops are noise; the [R, 8, D] operand is
+~400 KB). Measured on chip (G=96, [384, 256, 64] bf16): this formulation
+is 63 us/call vs 128 us for a VPU broadcast-multiply-reduce formulation —
+elementwise [G, S, D] fp32 intermediates plus lane-dim reductions cost
+more than the whole DMA. Three formulations Mosaic rejects (bisected on
+chip, do not relearn): batched dots with NO lhs free dimension
+(dot_dimension_numbers parse error), an in-kernel [G, D] -> [G, 1, D]
+reshape, and an in-kernel [G, D] -> [G, 8, D] broadcast (both crash
+tpu_compile_helper) — hence the XLA-side broadcast. Remaining gap to the
+30.7 us DMA roofline: the 64-wide minor dim DMAs slabs at ~60% efficiency
+(measured: a pure-DMA kernel runs 52.6 us at minor-64 vs 36.7 us for the
+same bytes at minor-128).
+
+Reference capability re-expressed: model.py:255-310 (generate's per-token
+attention); the reference has no serving kernel — its decode path is a full
+O(S^2) forward per token.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LOG2E = 1.4426950408889634
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                   window: int | None):
+    """One grid step: G (batch, head) rows against their [S, D] cache slabs.
+
+    pos_ref: [1, 1] int32 in SMEM — the current fill position (attend to
+    cache rows j <= pos, and pos - j < window under sliding windows).
+    q: [G, 8, D] (8 identical sublane rows, broadcast by the caller);
+    k, v: [G, S, D]; o: [G, D].
+    """
+    pos = pos_ref[0, 0]
+    # [G, 8, S] scores in base-2 exponent units (exp2 softmax, as in the
+    # flash kernels — the VPU's exp2 is the cheap transcendental).
+    s = jax.lax.dot_general(
+        q_ref[:], k_ref[:],
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * (scale * _LOG2E)
+    jpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    valid = jpos <= pos
+    if window is not None:
+        valid &= pos - jpos < window
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp2(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    o = jax.lax.dot_general(
+        (p / safe_l).astype(v_ref.dtype), v_ref[:],
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [G, 8, D]; rows identical — keep the first
+    o_ref[:] = o[:, 0, :].astype(o_ref.dtype)
+
+
+def _pick_group(rows: int, s: int, d: int, itemsize: int) -> int | None:
+    """Largest group keeping both double-buffered K/V slabs inside VMEM
+    (measured flat 63.1-63.9 us/call across G 16..384 at the serving
+    shape — the grid is DMA-bound, so G only needs to be big enough to
+    amortize per-step overhead). None when even G=1 exceeds the budget
+    (prefixes past ~16k rows at d=64 bf16) — see ``supported``."""
+    # fp32 x narrow head: stay under the Mosaic compiler crash the flash
+    # kernels hit at fp32 d_head=16 with grouped batched dots (bisected on
+    # chip, capped in flash_attention._pick_group — same bug class, same cap).
+    groups = (2, 1) if itemsize == 4 and d < 32 else (96, 48, 32, 16, 8, 4, 2, 1)
+    for g in groups:
+        if rows % g == 0 and g * s * d * itemsize * 4 <= 8 * 1024 * 1024:
+            return g
+    return None
+
+
+def supported(s: int, d: int, itemsize: int) -> bool:
+    """Whether the attended prefix fits this kernel's single-slab VMEM
+    plan. Callers (models/decode._cached_attention "auto") fall back to
+    the masked-softmax path beyond it; a streamed multi-tile grid is the
+    flash forward's job, not worth duplicating for serving lengths."""
+    return _pick_group(1, s, d, itemsize) is not None
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "interpret"),
+)
+def decode_attention(q, k_cache, v_cache, pos, window: int | None = None,
+                     interpret: bool | None = None):
+    """q: [B, H, 1, D]; caches: [B, H, S, D]; pos: scalar int32 (traced).
+
+    Returns [B, H, 1, D] in q.dtype — same contract as the masked-softmax
+    path (models/decode._cached_attention): attend to cache rows j <= pos,
+    additionally j > pos - window under a sliding window. The caller slices
+    the caches to the static attended prefix; S here is that bucket length.
+    """
+    b, h, _, d = q.shape
+    s = k_cache.shape[-2]
+    rows = b * h
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g = _pick_group(rows, s, d, k_cache.dtype.itemsize)
+    if g is None:
+        raise ValueError(
+            f"attended prefix [{s}, {d}] ({k_cache.dtype}) exceeds the "
+            "decode kernel's VMEM slab plan; use the masked-softmax path "
+            "(impl='xla') for prefixes this long"
+        )
+    scale = 1.0 / (d ** 0.5)
+
+    # bq=8 identical q rows, broadcast HERE: Mosaic rejects both the
+    # no-free-dim batched dot and the in-kernel broadcast (module notes).
+    q8 = jnp.broadcast_to(q.reshape(rows, 1, d), (rows, 8, d))
+    kf = k_cache.reshape(rows, s, d)
+    vf = v_cache.reshape(rows, s, d)
+    pos2 = jnp.asarray(pos, jnp.int32).reshape(1, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, window=window),
+        grid=(rows // g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((g, 8, d), lambda r: (r, 0, 0)),
+            pl.BlockSpec((g, s, d), lambda r: (r, 0, 0)),
+            pl.BlockSpec((g, s, d), lambda r: (r, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, d), lambda r: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), q.dtype),
+        interpret=interpret,
+    )(pos2, q8, kf, vf)
+    return out.reshape(b, h, 1, d)

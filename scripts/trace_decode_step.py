@@ -1,0 +1,62 @@
+"""Trace the batched KV-cache decode scan and print the device-time
+breakdown per generated token.
+
+Same measurement recipe as trace_headline_step.py (device-lane durations
+only). Attributes the gap between the decode artifact's device_est and the
+analytic HBM roofline (results/decode_v5e.txt: frac 0.36 at b32).
+
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_decode_step.py [logdir]
+"""
+
+import sys
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.decode import generate_kv_batched
+from cs336_systems_tpu.models.transformer import config_for_size, init_transformer_lm
+from cs336_systems_tpu.utils.profiling import summarize_trace, trace
+
+
+def main() -> None:
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/decode_trace"
+    on_tpu = jax.default_backend() == "tpu"
+    batch, prompt, new = (32, 64, 128) if on_tpu else (2, 8, 8)
+    cfg = config_for_size(
+        "small",
+        context_length=512,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        attn_impl="xla",
+        scan_layers=not on_tpu,
+    )
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt), 0, cfg.vocab_size)
+
+    def run():
+        toks = generate_kv_batched(
+            params, cfg, ids, new, jax.random.PRNGKey(2),
+            temperature=0.8, top_k=50,
+        )
+        jax.device_get(toks)
+
+    run()  # compile + warm
+    with trace(logdir):
+        run()
+
+    rows, total = summarize_trace(logdir, top=30)
+    print(f"trace: {logdir}   leaf device time {total / new * 1000:.1f} us/token"
+          f"   ({total:.1f} ms total, {new} tokens, batch {batch})")
+    print(f"{'op':40s} {'us/token':>9s} {'count':>7s} {'mean_us':>9s}")
+    for r in rows:
+        print(
+            f"{r['op'][:40]:40s} {r['total_ms'] / new * 1000:9.1f} "
+            f"{r['count']:7d} {r['mean_us']:9.1f}"
+        )
+
+
+if __name__ == "__main__":
+    main()
