@@ -92,7 +92,7 @@ def benchmark_decode(
         # cfg.attn_impl only steers the TRAINING/prefill attention op; the
         # per-token decode attention has its own dispatch (generate_kv's
         # attn_impl arg, default "auto" = the fused Pallas decode kernel
-        # on TPU, masked-softmax elsewhere — models/decode._cached_attention)
+        # on TPU, masked-softmax elsewhere — models/decode._decode_block)
         attn_impl="xla",
     )
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
@@ -145,28 +145,32 @@ def benchmark_decode(
     # rows carry that constant, CLAUDE.md).
     for b in batch_sizes:
         prompts = jnp.tile(jnp.asarray([prompt], jnp.int32), (b, 1))
-        dt_b, _ = _time_best(
-            lambda: generate_kv_batched(
-                params, cfg, prompts, new_tokens, key,
-                temperature=0.8, top_k=50,
-            ),
-            reps,
-        )
-        roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
-        dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
-        rows.append(
-            {
-                "path": f"kv_cache_b{b}",
-                "prompt": prompt_len,
-                "new_tokens": new_tokens,
-                "total_ms": round(dt_b * 1e3, 1),
-                "tokens_per_s": round(b * new_tokens / dt_b, 1),
-                "ms_per_token": round(dt_b * 1e3 / (b * new_tokens), 3),
-                "roofline_ms": round(roof_ms, 1),
-                "device_est_ms": round(dev_ms, 1),
-                "roofline_frac": round(roof_ms / dev_ms, 2) if dev_ms > 0 else None,
-            }
-        )
+        # exact sampling (reference semantics: full-sort top-k) and the
+        # approx_top_k variant (TPU partial-reduction threshold — the
+        # exact sort costs a flat ~293 us/token at the 10k vocab, traced)
+        for tag, approx in (("", False), ("_approxk", True)):
+            dt_b, _ = _time_best(
+                lambda: generate_kv_batched(
+                    params, cfg, prompts, new_tokens, key,
+                    temperature=0.8, top_k=50, approx_top_k=approx,
+                ),
+                reps,
+            )
+            roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
+            dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
+            rows.append(
+                {
+                    "path": f"kv_cache_b{b}{tag}",
+                    "prompt": prompt_len,
+                    "new_tokens": new_tokens,
+                    "total_ms": round(dt_b * 1e3, 1),
+                    "tokens_per_s": round(b * new_tokens / dt_b, 1),
+                    "ms_per_token": round(dt_b * 1e3 / (b * new_tokens), 3),
+                    "roofline_ms": round(roof_ms, 1),
+                    "device_est_ms": round(dev_ms, 1),
+                    "roofline_frac": round(roof_ms / dev_ms, 2) if dev_ms > 0 else None,
+                }
+            )
 
     if uncached:
         # reference semantics: full forward per token (model.py:283-308)

@@ -9,9 +9,10 @@ draw, EOS stop, context-window bound) are preserved exactly.
 
 TPU-first design:
 
-- The cache is a pytree of per-layer [B, H, S_max, Dh] leaves (one XLA
-  buffer per layer — see ``init_kv_cache`` for why that beats a stacked
-  [L, ...] array by ~10× per token) and the whole decode LOOP runs inside
+- The cache is a pytree of per-layer PACKED [B, H, S_max, 2·Dh] K‖V
+  leaves (one XLA buffer per layer — see ``init_kv_cache`` for the
+  packing rationale and why per-layer leaves beat a stacked [L, ...]
+  array by ~10× per token) and the whole decode LOOP runs inside
   a single jit (``lax.scan`` over steps, PRNG key threaded through the
   carry) — one dispatch per generation, not per token, which matters when
   host→device dispatch costs milliseconds.
@@ -38,82 +39,98 @@ from cs336_systems_tpu.models.transformer import (
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
-    """Zeroed cache pytree: {"k", "v"} of per-layer TUPLES of
-    [B, H, S_max, Dh] arrays (compute dtype).
+    """Zeroed cache pytree: {"kv"} — a per-layer TUPLE of PACKED
+    [B, H, S_max, 2*Dh] arrays (compute dtype; K in lanes [0, Dh), V in
+    [Dh, 2*Dh) — ops/decode_attention.pack_kv).
+
+    Packed K‖V on the lane axis because the decode kernel reads both
+    anyway and at Dh=64 the packed width is one full 128-lane tile: the
+    slab DMA runs at full rate where separate 64-wide K/V slabs measured
+    ~60% efficiency, and the per-token column write is ONE in-kernel tile
+    update instead of two XLA dynamic-update-slices (7.3 us each, traced).
 
     Per-layer leaves rather than one stacked [L, ...] array on purpose:
-    each leaf is its own XLA buffer, so the one-column
-    ``dynamic_update_slice`` per layer aliases in place through the decode
-    scan's carry. A stacked cache forces the layer loop to dynamic-slice
-    and re-stack every layer's whole [B, H, S, Dh] slab per token — traced
-    on v5e that was ~13 ms/token of pure cache copies at B=32 (copy +
-    dynamic-slice + dynamic-update-slice fusions), ~10× the actual
-    attention+matmul work.
+    each leaf is its own XLA buffer, so the one-tile in-place update
+    aliases through the decode scan's carry. A stacked cache forces the
+    layer loop to dynamic-slice and re-stack every layer's whole slab per
+    token — traced on v5e that was ~13 ms/token of pure cache copies at
+    B=32, ~10× the actual attention+matmul work.
     """
     s = max_len or cfg.context_length
-    shape = (batch, cfg.num_heads, s, cfg.d_head)
+    shape = (batch, cfg.num_heads, s, 2 * cfg.d_head)
     return {
-        "k": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
-        "v": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
+        "kv": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
     }
 
 
-def _cached_attention(q, k_cache, v_cache, pos, window: int | None = None,
-                      attend_len: int | None = None, impl: str = "auto"):
-    """q: [B,H,1,Dh]; caches [B,H,S,Dh]; attend to positions <= pos.
-
-    ``impl="pallas"`` (the "auto" choice on TPU) runs the fused decode
-    kernel (ops/decode_attention.py): scores, mask, softmax, and the
-    weighted-V reduction in VMEM with each cache slab streamed once —
-    the XLA masked-softmax lowering measured ~3.4x off the cache-read
-    roofline at serving batch (trace attribution in the kernel's module
-    docstring). ``impl="xla"`` delegates to the shared masked-softmax op
-    (ops/attention.py) — the mask [1, S] selects the filled cache prefix.
-    Both paths: with ``window`` set (sliding-window attention,
-    transformer.TransformerConfig.attn_window) the mask additionally
-    requires ``pos - j < window``, matching
-    ``ops.attention.banded_causal_mask`` row ``pos`` so cached decoding
-    agrees with the uncached ``generate`` numerics.
-
-    ``attend_len``: STATIC upper bound on the filled length (caller
-    guarantees pos < attend_len) — the cache reads are sliced to the first
-    ``attend_len`` rows. Decode is HBM-bound (the K/V cache is the
-    dominant per-token traffic at serving batch sizes), so not touching
-    the unfilled tail is a bandwidth saving proportional to
-    (1 − fill/S_max), not a FLOP nicety."""
+def _resolve_impl(impl: str, attend: int, d: int, itemsize: int) -> str:
+    """Serving-kernel choice: "auto" = the fused Pallas update+attend
+    kernel on TPU (falls back to "xla" beyond its VMEM slab plan),
+    "pallas"/"xla" force. NOT TransformerConfig.attn_impl (that steers the
+    training/prefill attention op)."""
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(
             f"unknown decode attention impl: {impl!r} (want 'auto', "
             "'pallas' or 'xla' — this is the serving-kernel choice, not "
             "TransformerConfig.attn_impl)"
         )
-    if attend_len is not None and attend_len < k_cache.shape[-2]:
-        k_cache = k_cache[:, :, :attend_len]
-        v_cache = v_cache[:, :, :attend_len]
     if impl == "auto":
         from cs336_systems_tpu.ops import decode_attention as da
 
-        fits = da.supported(
-            k_cache.shape[-2], k_cache.shape[-1], k_cache.dtype.itemsize
-        )
+        # the kernel also needs an 8-row-aligned attended prefix (its
+        # write-back tile) — non-multiple-of-8 lengths take the xla path
+        fits = attend % 8 == 0 and da.supported(attend, d, itemsize)
         impl = "pallas" if fits and jax.default_backend() == "tpu" else "xla"
-    if impl == "pallas":
-        from cs336_systems_tpu.ops.decode_attention import decode_attention
+    return impl
 
-        return decode_attention(q, k_cache, v_cache, pos, window=window)
-    s = k_cache.shape[-2]
+
+def _attend_update_xla(q, kv_cache, k_new, v_new, pos,
+                       window: int | None = None,
+                       attend_len: int | None = None):
+    """Portable update+attend on the packed cache: write the packed new
+    column with a dynamic-update-slice, then the shared masked-softmax op
+    (ops/attention.py — the oracle the Pallas kernel is tested against)
+    over the filled prefix. Mask rows j <= pos; with ``window`` set the
+    mask additionally requires ``pos - j < window``, matching
+    ``ops.attention.banded_causal_mask`` row ``pos`` so cached decoding
+    agrees with the uncached ``generate`` numerics.
+
+    ``attend_len``: STATIC bound on the filled length (pos < attend_len);
+    only that prefix is read. Decode is HBM-bound (the cache is the
+    dominant per-token traffic at serving batch), so not touching the
+    unfilled tail is a bandwidth saving proportional to 1 − fill/S_max.
+    The lane-unpack slices here COPY k/v — fine for CPU tests and the
+    long-prefix fallback; the TPU serving path is the fused kernel."""
+    from cs336_systems_tpu.ops.attention import attention_with_lse
+    from cs336_systems_tpu.ops.decode_attention import pack_kv
+
+    d = q.shape[-1]
+    kv_cache = jax.lax.dynamic_update_slice(
+        kv_cache, pack_kv(k_new, v_new), (0, 0, pos, 0)
+    )
+    kv_read = kv_cache
+    if attend_len is not None and attend_len < kv_read.shape[-2]:
+        kv_read = kv_read[:, :, :attend_len]
+    s = kv_read.shape[-2]
     idx = jnp.arange(s)
     mask = idx <= pos
     if window is not None:
         mask &= pos - idx < window
-    from cs336_systems_tpu.ops.attention import attention_with_lse
+    o = attention_with_lse(
+        q, kv_read[..., :d], kv_read[..., d:], mask[None, :]
+    )[0]
+    return o, kv_cache
 
-    return attention_with_lse(q, k_cache, v_cache, mask[None, :])[0]
 
-
-def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig,
+def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
                   attend_len: int | None = None, attn_impl: str = "auto"):
-    """One block on a single-token hidden state; returns (x, kc, vc)."""
+    """One block on a single-token hidden state; returns (x, kv').
+
+    ``kv``: this layer's packed [B, H, S, 2*Dh] cache (init_kv_cache).
+    The new token's K/V column is written at ``pos`` and attention runs
+    over rows <= pos — in ONE fused Pallas kernel on TPU (in-place tile
+    write, ops/decode_attention.decode_attention_update), or a
+    dynamic-update-slice + the shared masked-softmax op elsewhere."""
     b = x.shape[0]
     h, dh = cfg.num_heads, cfg.d_head
     hsplit = lambda t: t.reshape(b, 1, h, dh).transpose(0, 2, 1, 3)
@@ -126,14 +143,24 @@ def _decode_block(bp, x, kc, vc, cos, sin, pos, cfg: TransformerConfig,
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
-    kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
-    vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
-    attn = _cached_attention(q, kc, vc, pos, cfg.attn_window, attend_len,
-                             attn_impl)
+    attend = attend_len if attend_len is not None else kv.shape[-2]
+    impl = _resolve_impl(attn_impl, attend, dh, kv.dtype.itemsize)
+    if impl == "pallas":
+        from cs336_systems_tpu.ops.decode_attention import (
+            decode_attention_update,
+        )
+
+        attn, kv = decode_attention_update(
+            q, k, v, kv, pos, window=cfg.attn_window, attend_len=attend_len,
+        )
+    else:
+        attn, kv = _attend_update_xla(
+            q, kv, k, v, pos, cfg.attn_window, attend_len
+        )
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
     x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
     x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
-    return x, kc, vc
+    return x, kv
 
 
 def _ffn(ffn_params, x, cfg: TransformerConfig):
@@ -165,7 +192,7 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
 
     ``attend_len``: static bound on the filled cache length (pos <
     attend_len); attention reads only that prefix — see
-    ``_cached_attention``. ``params["blocks"]`` may be the stacked
+    ``_decode_block``. ``params["blocks"]`` may be the stacked
     [L, ...]-leaf pytree (the training layout) or a tuple of per-layer
     pytrees (``unstack_blocks``) — inside the generation scan the caller
     unstacks ONCE so the per-layer slices are loop-invariant; left stacked,
@@ -176,24 +203,23 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
     x = embedding(params["token_embeddings"], token_ids[:, None], cfg.cdtype)
 
     # Unrolled layer loop over per-layer cache leaves (see init_kv_cache):
-    # each layer's one-column cache update aliases in place.
+    # each layer's one-tile cache update aliases in place.
     blocks = params["blocks"]
     stacked = not isinstance(blocks, (tuple, list))
-    kcs, vcs = [], []
+    kvs = []
     for l in range(cfg.num_layers):
         bp = (
             jax.tree_util.tree_map(lambda a: a[l], blocks) if stacked
             else blocks[l]
         )
-        x, kc, vc = _decode_block(
-            bp, x, cache["k"][l], cache["v"][l], cos, sin, pos, cfg,
+        x, kv = _decode_block(
+            bp, x, cache["kv"][l], cos, sin, pos, cfg,
             attend_len, attn_impl,
         )
-        kcs.append(kc)
-        vcs.append(vc)
+        kvs.append(kv)
     x = rmsnorm(params["ln_final"], x)
     logits = linear(params["lm_head"], x, cfg.cdtype)[:, 0]
-    return logits.astype(jnp.float32), {"k": tuple(kcs), "v": tuple(vcs)}
+    return logits.astype(jnp.float32), {"kv": tuple(kvs)}
 
 
 def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None):
@@ -241,16 +267,14 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     x = rmsnorm(params["ln_final"], x)
     logits = linear(params["lm_head"], x, cfg.cdtype)[:, -1].astype(jnp.float32)
 
-    # write each layer's [B, H, P, Dh] prompt K/V into its cache prefix
-    # (one-time cost at prefill; the leaves stay separate — init_kv_cache)
+    # write each layer's packed [B, H, P, 2*Dh] prompt K/V into its cache
+    # prefix (one-time cost at prefill; per-layer leaves — init_kv_cache)
+    from cs336_systems_tpu.ops.decode_attention import pack_kv
+
     cache = {
-        "k": tuple(
-            jax.lax.dynamic_update_slice(c, ks[l], (0, 0, 0, 0))
-            for l, c in enumerate(cache["k"])
-        ),
-        "v": tuple(
-            jax.lax.dynamic_update_slice(c, vs[l], (0, 0, 0, 0))
-            for l, c in enumerate(cache["v"])
+        "kv": tuple(
+            jax.lax.dynamic_update_slice(c, pack_kv(ks[l], vs[l]), (0, 0, 0, 0))
+            for l, c in enumerate(cache["kv"])
         ),
     }
     return logits, cache, plen
@@ -380,7 +404,7 @@ def generate_kv(
 
     ``attn_impl``: cached-attention kernel ("auto" = the fused Pallas
     decode kernel on TPU, masked-softmax XLA elsewhere — see
-    ``_cached_attention``). ``approx_top_k``: TPU-native approximate top-k
+    ``_decode_block``). ``approx_top_k``: TPU-native approximate top-k
     threshold instead of the full-sort exact form (see ``_sample``).
 
     Note: prompt + max_new_tokens must fit the context window (the cache is

@@ -254,13 +254,17 @@ def count_params(params, non_embedding: bool = True) -> int:
 # Apply
 
 
-def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
+def _attention(q, k, v, cfg: TransformerConfig, mesh=None, ring_rope=None):
     """Dispatch the attention inner op. q/k/v: [B, H, S, Dh].
 
     ``mesh`` (a ``jax.sharding.Mesh``): required only when
     ``cfg.attn_batch_shard`` / ``cfg.attn_head_shard`` declare the operands
     sharded — the flash kernel then runs in a ``shard_map`` over those axes
-    with its local [B/dp, H/tp, S, Dh] block (see the config fields)."""
+    with its local [B/dp, H/tp, S, Dh] block (see the config fields).
+
+    ``ring_rope``: (cos, sin, positions) when the ring path fuses RoPE
+    in-kernel (cfg.rope_fused) — q/k arrive UNROTATED and each hop rotates
+    in VMEM at the hop block's global positions (parallel/ring.py)."""
     if cfg.attn_impl == "xla":
         if cfg.attn_window is not None:
             from cs336_systems_tpu.ops.attention import banded_causal_mask
@@ -309,9 +313,13 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
 
         b, h, s, dh = q.shape
         fold = lambda x: x.reshape(b * h, s, dh)
+        rope_kw = {}
+        if ring_rope is not None:
+            cos, sin, positions = ring_rope
+            rope_kw = dict(rope_cos=cos, rope_sin=sin, positions=positions)
         out = ring_attention(
             fold(q), fold(k), fold(v), axis=cfg.sp_axis, causal=True,
-            window=cfg.attn_window,
+            window=cfg.attn_window, **rope_kw,
         )
         return out.reshape(b, h, s, dh)
     raise ValueError(f"unknown attn_impl: {cfg.attn_impl}")
@@ -408,11 +416,19 @@ def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig,
         q = split(linear(p["q_proj"], x, cfg.cdtype))
         k = split(linear(p["k_proj"], x, cfg.cdtype))
         v = split(linear(p["v_proj"], x, cfg.cdtype))
-    with jax.named_scope("rope"):
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+    ring_rope = None
+    if cfg.attn_impl == "ring" and cfg.rope_fused and positions.ndim == 1:
+        # rotate inside the ring hops' kernels (parallel/ring.py) — no
+        # rope op between the projections and the custom calls, matching
+        # the single-device fused-rope default. Per-batch positions fall
+        # back to the XLA rotation (the per-row table API is shared-[S]).
+        ring_rope = (cos, sin, positions)
+    else:
+        with jax.named_scope("rope"):
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
     with jax.named_scope("sdpa"):
-        out = _attention(q, k, v, cfg, mesh)
+        out = _attention(q, k, v, cfg, mesh, ring_rope)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
     with jax.named_scope("out_proj"):
         return linear(p["output_proj"], out, cfg.cdtype)

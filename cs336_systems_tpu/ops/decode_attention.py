@@ -1,42 +1,54 @@
-"""Fused decode-attention kernel: one cached-attention row per (batch, head).
+"""Fused decode-attention kernel: cache update + one cached-attention row
+per (batch, head), in place.
 
 Serving-path counterpart of ops/flash_attention.py. At decode, attention is
 a matvec per (batch, head) — q is ONE row against the filled K/V cache
-prefix — and the cost is pure HBM bandwidth: read the caches once. XLA
-lowers the masked-softmax formulation (ops/attention.py via
-models/decode._cached_attention) to per-layer ``multiply_reduce`` fusions
-that measured ~3.4x off the cache-read roofline on v5e (the [.., 1, S] x
-[.., S, 64] matvec reads the 64-wide minor dim at half lane occupancy, and
-the softmax runs as separate fusions over re-read score rows —
-scripts/trace_decode_step.py attributes 1256 of 2064 us/token to them at
-b32).
+prefix — and the cost is pure HBM bandwidth: read the caches once, write
+one row. The XLA formulation (masked softmax over the cache + a
+dynamic-update-slice per layer for the new column) measured ~3.4x off the
+cache-read roofline at serving batch on v5e, with the column DUS adding
+7.3 us/op of scattered-write latency once attention became an opaque
+custom call (trace attributions: scripts/trace_decode_step.py).
 
-This kernel streams each (batch-head group)'s K and V slabs through VMEM
-exactly once per token: scores, the causal/window mask at the traced fill
-position, the softmax, and the weighted-V reduction all happen in VMEM
-between the two DMAs. No online-softmax state is needed — the whole filled
-prefix (bucket-rounded by the caller, models/decode._ATTEND_BUCKET) fits
-VMEM per group, so this is the single-tile fast path of the flash forward
-with a runtime (SMEM) mask position instead of a static grid offset.
+Design (all measured on chip, see the numbers below):
 
-The matvecs run on the MXU as batched dots in the flash kernels'
-known-good [G, bq, bk] shape, with q broadcast to bq=8 identical sublane
-rows IN XLA (the 8x extra MXU flops are noise; the [R, 8, D] operand is
-~400 KB). Measured on chip (G=96, [384, 256, 64] bf16): this formulation
-is 63 us/call vs 128 us for a VPU broadcast-multiply-reduce formulation —
-elementwise [G, S, D] fp32 intermediates plus lane-dim reductions cost
-more than the whole DMA. Three formulations Mosaic rejects (bisected on
-chip, do not relearn): batched dots with NO lhs free dimension
-(dot_dimension_numbers parse error), an in-kernel [G, D] -> [G, 1, D]
-reshape, and an in-kernel [G, D] -> [G, 8, D] broadcast (both crash
-tpu_compile_helper) — hence the XLA-side broadcast. Remaining gap to the
-30.7 us DMA roofline: the 64-wide minor dim DMAs slabs at ~60% efficiency
-(measured: a pure-DMA kernel runs 52.6 us at minor-64 vs 36.7 us for the
-same bytes at minor-128).
+- K and V are PACKED into one [rows, S, 2*Dh] cache array per layer — K in
+  lanes [0, Dh), V in [Dh, 2*Dh). At Dh=64 the packed lane width is one
+  full 128-lane tile: the slab DMA runs at full rate where the separate
+  64-wide slabs measured ~60% efficiency (52.6 vs 36.7 us for the same
+  bytes), and K+V arrive in ONE stream.
+- q is zero-extended over the V lanes and broadcast to 8 identical sublane
+  rows IN XLA ([rows, 8, W]): the score dot contracts the full packed
+  width, the zeros kill the q.V cross terms, and the batched dots keep the
+  flash kernels' known-good [G, bq, bk] Mosaic shape. (Bisected rejects,
+  do not relearn: a batched dot with NO lhs free dimension fails to parse;
+  in-kernel [G, D] -> [G, 1, D] reshapes and [G, D] -> [G, 8, D]
+  broadcasts crash tpu_compile_helper.)
+- The NEW token's K/V column rides a separate tiny operand (packed,
+  broadcast to 8 rows). Scores against the cache mask strictly j < pos;
+  the current token's contribution comes from the operand, so the kernel
+  never depends on the column being written first.
+- The column write happens IN the kernel: the packed cache is an
+  input/output-aliased buffer whose output BlockSpec addresses the single
+  8-row tile containing ``pos`` (a scalar-prefetch index map — Mosaic
+  requires HBM writes in 8-row-aligned tiles, which is also why a manual
+  per-row DMA was rejected: "Slice shape along dimension 1 must be aligned
+  to tiling (8)"). The kernel merges the new column into the tile read
+  from the slab already in VMEM and writes that one tile back; the rest of
+  the aliased buffer is untouched. The XLA-level DUS ops (182 us/token at
+  b32) disappear, and the cache stays in place through the generation scan
+  (the carry donates cleanly).
+
+Measured (chip, [384, 256, 64] bf16, 2048-step scan slope, floor-
+cancelled): fused-packed 19.7 us/step vs 31.1 for the unpacked kernel +
+XLA column DUS. The sub-roofline slope (25.2 MB slab at an implied
+~1.8-2.5 TB/s) indicates XLA keeps the donated in-place cache buffer in
+fast memory across the scan at this size; scaling S shows the slab is
+genuinely streamed (slope 10/27/55 us at S=256/512/1024).
 
 Reference capability re-expressed: model.py:255-310 (generate's per-token
-attention); the reference has no serving kernel — its decode path is a full
-O(S^2) forward per token.
+attention); the reference has no serving kernel — its decode path is a
+full O(S^2) forward per token.
 """
 
 from __future__ import annotations
@@ -52,108 +64,164 @@ _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
-                   window: int | None):
-    """One grid step: G (batch, head) rows against their [S, D] cache slabs.
+def pack_kv(k, v):
+    """K, V [..., Dh] -> packed [..., 2*Dh] (K in lanes [0, Dh))."""
+    return jnp.concatenate([k, v], axis=-1)
 
-    pos_ref: [1, 1] int32 in SMEM — the current fill position (attend to
-    cache rows j <= pos, and pos - j < window under sliding windows).
-    q: [G, 8, D] (8 identical sublane rows, broadcast by the caller);
-    k, v: [G, S, D]; o: [G, D].
+
+def _decode_update_kernel(pos_ref, qp_ref, newt_ref, kv_ref, kvtile_ref,
+                          o_ref, *, scale: float, window: int | None):
+    """One grid step: G (batch, head) rows against their packed [S, W]
+    cache slabs, plus the in-place 8-row tile write-back.
+
+    pos_ref: [1] int32 scalar-prefetch — the write position (cache rows
+    j < pos are attended; row pos comes from ``newt``).
+    qp: [G, 8, W] (q zero-extended over V lanes, 8 identical rows);
+    newt: [G, 8, W] (packed new K/V column, 8 identical rows);
+    kv: [G, S_attend, W]; outputs: kvtile [G, 8, W] (the aliased cache's
+    tile at pos//8), o [G, W].
     """
-    pos = pos_ref[0, 0]
-    # [G, 8, S] scores in base-2 exponent units (exp2 softmax, as in the
-    # flash kernels — the VPU's exp2 is the cheap transcendental).
+    pos = pos_ref[0]
+    g, _, w = qp_ref.shape
     s = jax.lax.dot_general(
-        q_ref[:], k_ref[:],
-        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        qp_ref[:], kv_ref[:], (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
-    ) * (scale * _LOG2E)
+    ) * (scale * _LOG2E)  # [G, 8, S] in base-2 exponent units
     jpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-    valid = jpos <= pos
+    valid = jpos < pos
     if window is not None:
         valid &= pos - jpos < window
     s = jnp.where(valid, s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp2(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    safe_l = jnp.where(l > 0.0, l, 1.0)
-    o = jax.lax.dot_general(
-        (p / safe_l).astype(v_ref.dtype), v_ref[:],
-        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+    s_new = jax.lax.dot_general(
+        qp_ref[:], newt_ref[:], (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
-    )  # [G, 8, D]; rows identical — keep the first
-    o_ref[:] = o[:, 0, :].astype(o_ref.dtype)
+    ) * (scale * _LOG2E)  # [G, 8, 8] — identical columns
+    m = jnp.maximum(
+        jnp.max(s, axis=-1, keepdims=True),
+        jnp.max(s_new, axis=-1, keepdims=True),
+    )
+    p = jnp.exp2(s - m)
+    p_new = jnp.exp2(s_new - m)
+    # mean over the 8 identical columns == the one true p_new value; the
+    # second dot sums the 8 identical rows of newt, hence the /8.
+    l = (jnp.sum(p, axis=-1, keepdims=True)
+         + jnp.mean(p_new, axis=-1, keepdims=True))
+    acc = jax.lax.dot_general(
+        p.astype(kv_ref.dtype), kv_ref[:], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) + jax.lax.dot_general(
+        (p_new / 8.0).astype(newt_ref.dtype), newt_ref[:],
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [G, 8, W]; lanes [0, Dh) hold p.K garbage the caller slices off
+    o_ref[:] = (acc / l)[:, :1, :].astype(o_ref.dtype)  # [G, 1, W] block
+
+    # merge the new column into the 8-row tile containing pos and write it
+    # back through the aliased output block (indexed at pos//8); the rest
+    # of the cache buffer is never touched.
+    base = (pos // 8) * 8
+    orig = kv_ref[:, pl.dslice(base, 8), :]
+    row = jax.lax.broadcasted_iota(jnp.int32, (g, 8, w), 1)
+    kvtile_ref[:] = jnp.where(row == pos - base, newt_ref[:], orig)
 
 
-def _pick_group(rows: int, s: int, d: int, itemsize: int) -> int | None:
-    """Largest group keeping both double-buffered K/V slabs inside VMEM
-    (measured flat 63.1-63.9 us/call across G 16..384 at the serving
-    shape — the grid is DMA-bound, so G only needs to be big enough to
-    amortize per-step overhead). None when even G=1 exceeds the budget
-    (prefixes past ~16k rows at d=64 bf16) — see ``supported``."""
-    # fp32 x narrow head: stay under the Mosaic compiler crash the flash
-    # kernels hit at fp32 d_head=16 with grouped batched dots (bisected on
-    # chip, capped in flash_attention._pick_group — same bug class, same cap).
+def _pick_group(rows: int, s: int, w: int, itemsize: int,
+                d: int) -> int | None:
+    """Largest group keeping the double-buffered packed slab inside VMEM
+    (measured flat across G 16..384 at the serving shape — the grid is
+    DMA-bound, so G only needs to amortize per-step overhead). None when
+    even G=1 exceeds the budget — see ``supported``. fp32 x narrow head
+    stays under the Mosaic grouped-dot crash the flash kernels hit at
+    fp32 d_head=16 (bisected on chip, same cap as
+    flash_attention._pick_group)."""
+    # Any divisor works as a group: every block's trailing two dims equal
+    # the array's (the o output is [rows, 1, w] so its (g, 1, w) block is
+    # Mosaic-legal at ANY g — a 2-D (g, w) block would force g % 8 == 0).
     groups = (2, 1) if itemsize == 4 and d < 32 else (96, 48, 32, 16, 8, 4, 2, 1)
     for g in groups:
-        if rows % g == 0 and g * s * d * itemsize * 4 <= 8 * 1024 * 1024:
+        if rows % g == 0 and g * s * w * itemsize * 2 <= 8 * 1024 * 1024:
             return g
     return None
 
 
 def supported(s: int, d: int, itemsize: int) -> bool:
-    """Whether the attended prefix fits this kernel's single-slab VMEM
-    plan. Callers (models/decode._cached_attention "auto") fall back to
-    the masked-softmax path beyond it; a streamed multi-tile grid is the
+    """Whether an attended prefix of ``s`` rows fits the kernel's
+    single-slab VMEM plan. Callers (models/decode) fall back to the
+    XLA masked-softmax path beyond it; a streamed multi-tile grid is the
     flash forward's job, not worth duplicating for serving lengths."""
-    return _pick_group(1, s, d, itemsize) is not None
+    return _pick_group(1, s, 2 * d, itemsize, d) is not None
 
 
 @functools.partial(
-    jax.jit, static_argnames=("window", "interpret"),
+    jax.jit, static_argnames=("window", "attend_len", "interpret"),
 )
-def decode_attention(q, k_cache, v_cache, pos, window: int | None = None,
-                     interpret: bool | None = None):
-    """q: [B, H, 1, D]; caches: [B, H, S, D]; pos: scalar int32 (traced).
+def decode_attention_update(q, k_new, v_new, kv_cache, pos,
+                            window: int | None = None,
+                            attend_len: int | None = None,
+                            interpret: bool | None = None):
+    """q, k_new, v_new: [B, H, 1, Dh]; kv_cache: [B, H, S, 2*Dh] packed;
+    pos: scalar int32 (traced) -> (o [B, H, 1, Dh], updated kv_cache).
 
-    Returns [B, H, 1, D] in q.dtype — same contract as the masked-softmax
-    path (models/decode._cached_attention): attend to cache rows j <= pos,
-    additionally j > pos - window under a sliding window. The caller slices
-    the caches to the static attended prefix; S here is that bucket length.
+    Attends rows j < pos of the cache prefix plus the new column, and
+    writes the packed new column at row ``pos`` — in place when XLA can
+    donate the cache (it does for jit arguments marked donated and for
+    scan carries, which is how the generation scan calls this).
+
+    ``attend_len``: STATIC bound on the filled prefix (caller guarantees
+    pos < attend_len, multiple of 8); only that many rows are streamed —
+    the block simply does not cover the cache tail. The write-back tile
+    always addresses the full array, so the updated cache keeps shape S.
     """
     b, h, _, d = q.shape
-    s = k_cache.shape[-2]
+    s_all = kv_cache.shape[-2]
+    w = kv_cache.shape[-1]
+    if w != 2 * d:
+        raise ValueError(f"packed cache width {w} != 2*d_head ({2 * d})")
+    attend = attend_len if attend_len is not None else s_all
+    if attend % 8 != 0:
+        raise ValueError(f"attend_len must be a multiple of 8, got {attend}")
     rows = b * h
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    g = _pick_group(rows, s, d, k_cache.dtype.itemsize)
+    g = _pick_group(rows, attend, w, kv_cache.dtype.itemsize, d)
     if g is None:
         raise ValueError(
-            f"attended prefix [{s}, {d}] ({k_cache.dtype}) exceeds the "
-            "decode kernel's VMEM slab plan; use the masked-softmax path "
-            "(impl='xla') for prefixes this long"
+            f"attended prefix [{attend}, {w}] ({kv_cache.dtype}) exceeds "
+            "the decode kernel's VMEM slab plan; use the masked-softmax "
+            "path (impl='xla') for prefixes this long"
         )
     scale = 1.0 / (d ** 0.5)
 
-    # bq=8 identical q rows, broadcast HERE: Mosaic rejects both the
-    # no-free-dim batched dot and the in-kernel broadcast (module notes).
-    q8 = jnp.broadcast_to(q.reshape(rows, 1, d), (rows, 8, d))
-    kf = k_cache.reshape(rows, s, d)
-    vf = v_cache.reshape(rows, s, d)
-    pos2 = jnp.asarray(pos, jnp.int32).reshape(1, 1)
+    qp = jnp.concatenate([q, jnp.zeros_like(q)], axis=-1).reshape(rows, 1, w)
+    qp = jnp.broadcast_to(qp, (rows, 8, w))
+    newt = pack_kv(k_new, v_new).reshape(rows, 1, w)
+    newt = jnp.broadcast_to(newt, (rows, 8, w))
+    kvf = kv_cache.reshape(rows, s_all, w)
+    pos1 = jnp.asarray(pos, jnp.int32).reshape(1)
 
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, window=window),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(rows // g,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((g, 8, d), lambda r: (r, 0, 0)),
-            pl.BlockSpec((g, s, d), lambda r: (r, 0, 0)),
-            pl.BlockSpec((g, s, d), lambda r: (r, 0, 0)),
+            pl.BlockSpec((g, 8, w), lambda r, p: (r, 0, 0)),
+            pl.BlockSpec((g, 8, w), lambda r, p: (r, 0, 0)),
+            pl.BlockSpec((g, attend, w), lambda r, p: (r, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((g, d), lambda r: (r, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((g, 8, w), lambda r, p: (r, p[0] // 8, 0)),
+            # 3-D so the block's trailing dims equal the array's at any g
+            pl.BlockSpec((g, 1, w), lambda r, p: (r, 0, 0)),
+        ],
+    )
+    kv_out, o = pl.pallas_call(
+        functools.partial(_decode_update_kernel, scale=scale, window=window),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, s_all, w), kv_cache.dtype),
+            jax.ShapeDtypeStruct((rows, 1, w), q.dtype),
+        ],
+        input_output_aliases={3: 0},  # kv (after scalar, qp, newt) -> out 0
         interpret=interpret,
-    )(pos2, q8, kf, vf)
-    return out.reshape(b, h, 1, d)
+    )(pos1, qp, newt, kvf)
+    o_v = o[:, 0, d:].reshape(b, h, 1, d)  # V half; [0, d) is p.K garbage
+    return o_v, kv_out.reshape(b, h, s_all, w)

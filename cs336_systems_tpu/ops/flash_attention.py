@@ -178,12 +178,13 @@ def _flash_fwd_reference(q, k, v, causal: bool, q_tile: int, k_tile: int,
     ring/sequence-parallel hops attend a K/V block that sits ``q_off``
     positions behind the local queries (parallel/ring.py).
 
-    ``rope``: optional (cos2, sin2) full-width tables — the fused-rope
-    contract; on this portable path the rotation simply runs in XLA first.
+    ``rope``: optional (cq2, sq2, ck2, sk2) full-width tables (pre-sliced
+    to n_q/n_k — the fused-rope contract, _folded_call); on this portable
+    path the rotation simply runs in XLA first.
     """
     if rope is not None:
-        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
-        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
+        q = _apply_rope_full(q, rope[0], rope[1])
+        k = _apply_rope_full(k, rope[2], rope[3])
     in_dtype = q.dtype
     b, n_q, d = q.shape
     n_k = k.shape[1]
@@ -581,12 +582,12 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
     if rope is not None:
         # per-row cos/sin tables [S_pad, d], blocked by the q / k tile
         # index (the k blocks reuse k_index so banded clamping matches)
-        cos2 = _pad_to(rope[0], 0, max(bq, bk))
-        sin2 = _pad_to(rope[1], 0, max(bq, bk))
+        cq2, sq2 = _pad_to(rope[0], 0, bq), _pad_to(rope[1], 0, bq)
+        ck2, sk2 = _pad_to(rope[2], 0, bk), _pad_to(rope[3], 0, bk)
         q_tab = pl.BlockSpec((bq, d), lambda bi, qi, kj: (qi, 0))
         k_tab = pl.BlockSpec((bk, d), lambda bi, qi, kj: k_index(bi, qi, kj)[1:])
         in_specs += [q_tab, q_tab, k_tab, k_tab]
-        operands += [cos2, sin2, cos2, sin2]
+        operands += [cq2, sq2, ck2, sk2]
     o, lse = pl.pallas_call(
         kernel,
         grid=(b // g, tq, n_kt),
@@ -708,19 +709,18 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
     kernel (1.03 vs ~1.04 ms at 384 rows — compute-bound, consistent with
     the round-2 finding) with the end-to-end step trending ~1-2% faster;
     kept because it also unifies the recompute core with the tiled
-    kernels. fp32 stays PER-ROW: its S×S intermediates are 2× bf16's and
-    G=2 at the S=512 fp32 eligibility bound lands on the documented VMEM
-    edge (see _BWD_PALLAS_MAX_S_F32) — only the bf16 grouping is
-    chip-validated."""
+    kernels. fp32 grouping was audited on chip later in round 3: at the
+    S=512 eligibility bound the picker's G=2 compiles (the feared VMEM
+    edge does not bite) and beats per-row by ~8% (1.82 vs 1.97 ms bwd at
+    384 rows, shipping device-lane harness); S=256/128 and the narrow
+    head dims d=16/32 all compile and run — the fp32-narrow-head Mosaic
+    crash is specific to the FORWARD kernel's grouped dots."""
     b, n_q, d = q.shape
     n_k = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if q.dtype == jnp.bfloat16:
-        g = _pick_group_tiled_bwd(b, n_q, n_k, d, q.dtype.itemsize,
-                                  has_rope=rope is not None)
-    else:
-        g = 1
+    g = _pick_group_tiled_bwd(b, n_q, n_k, d, q.dtype.itemsize,
+                              has_rope=rope is not None)
     kernel = functools.partial(
         _flash_bwd_kernel, scale=1.0 / math.sqrt(d), causal=causal,
         window=window, q_off=q_off, has_dlse=dlse is not None,
@@ -742,7 +742,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, dlse, causal: bool,
     if rope is not None:
         tab = lambda rows: pl.BlockSpec((rows, d), lambda bi: (0, 0))
         in_specs += [tab(n_q), tab(n_q), tab(n_k), tab(n_k)]
-        operands += [rope[0][:n_q], rope[1][:n_q], rope[0][:n_k], rope[1][:n_k]]
+        operands += list(rope)  # (cq2, sq2, ck2, sk2), pre-sliced
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(b // g,),
@@ -1023,11 +1023,11 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
     ]
     dkv_operands = [q, k, v, do, lse_c, delta_c]
     if rope is not None:
-        cos2, sin2 = rope[0][:n_q], rope[1][:n_q]  # n_q == n_k (gated)
+        cq2, sq2, ck2, sk2 = rope  # pre-sliced to n_q / n_k (_folded_call)
         q_tab = pl.BlockSpec((bq, d), lambda bi, kj, qi: q_index(bi, kj, qi)[1:])
         k_tab = pl.BlockSpec((bk, d), lambda bi, kj, qi: (kj, 0))
         dkv_in_specs += [q_tab, q_tab, k_tab, k_tab]
-        dkv_operands += [cos2, sin2, cos2, sin2]
+        dkv_operands += [cq2, sq2, ck2, sk2]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_q_tiles=n_qt, window=window,
@@ -1070,7 +1070,7 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
         q_tab = pl.BlockSpec((bq, d), lambda bi, qi, kj: (qi, 0))
         k_tab = pl.BlockSpec((bk, d), lambda bi, qi, kj: k_index(bi, qi, kj)[1:])
         dq_in_specs += [q_tab, q_tab, k_tab, k_tab]
-        dq_operands += [cos2, sin2, cos2, sin2]
+        dq_operands += [cq2, sq2, ck2, sk2]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, n_k_tiles=n_kt_dq, window=window,
@@ -1106,8 +1106,8 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
     """
     in_dtype = q.dtype
     if rope is not None:
-        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
-        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
+        q = _apply_rope_full(q, rope[0], rope[1])
+        k = _apply_rope_full(k, rope[2], rope[3])
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32) * scale
@@ -1137,12 +1137,8 @@ def _flash_bwd_recompute(q, k, v, o, lse, do, dlse, causal: bool,
     dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32),
                     preferred_element_type=jnp.float32) * scale
     if rope is not None:
-        dq = _apply_rope_full(
-            dq, rope[0][: q.shape[1]], rope[1][: q.shape[1]], inverse=True
-        )
-        dk = _apply_rope_full(
-            dk, rope[0][: k.shape[1]], rope[1][: k.shape[1]], inverse=True
-        )
+        dq = _apply_rope_full(dq, rope[0], rope[1], inverse=True)
+        dk = _apply_rope_full(dk, rope[2], rope[3], inverse=True)
     return dq.astype(in_dtype), dk.astype(in_dtype), dv.astype(in_dtype)
 
 
@@ -1166,8 +1162,8 @@ def _flash_fwd_xla(q, k, v, causal: bool, window: int | None = None,
     )
 
     if rope is not None:
-        q = _apply_rope_full(q, rope[0][: q.shape[1]], rope[1][: q.shape[1]])
-        k = _apply_rope_full(k, rope[0][: k.shape[1]], rope[1][: k.shape[1]])
+        q = _apply_rope_full(q, rope[0], rope[1])
+        k = _apply_rope_full(k, rope[2], rope[3])
     if causal and window is not None:
         mask = banded_causal_mask(q.shape[1], k.shape[1], window, q_off)
     elif causal:
@@ -1297,26 +1293,56 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule, symbolic_zeros=True)
 
 
 def _folded_call(q, k, v, causal, impl, q_tile, k_tile, window=None,
-                 q_off=0, rope_cos=None, rope_sin=None):
-    """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash."""
+                 q_off=0, rope_cos=None, rope_sin=None,
+                 rope_cos_k=None, rope_sin_k=None):
+    """Fold [..., S, D] leading dims (or unsqueeze 2-D) and run _flash.
+
+    Internal fused-rope representation: a 4-tuple of full-width fp32
+    tables (cq2, sq2, ck2, sk2) sliced to exactly n_q / n_k rows. With no
+    explicit k tables, both slices come from the shared q table — valid
+    only at q_pos_offset == 0, where q and k rows share absolute
+    positions. Ring hops pass DISTINCT k tables gathered at the hop
+    block's global positions (parallel/ring.py), which is what unlocks
+    q_pos_offset != 0 under fused rope."""
     rope = None
     if rope_cos is not None:
-        if q.shape[-2] != k.shape[-2]:
-            raise ValueError(
-                "fused rope requires n_queries == n_keys (one per-row table "
-                f"serves both); got {q.shape[-2]} vs {k.shape[-2]}"
-            )
-        if q_off:
-            raise ValueError(
-                "fused rope requires q_pos_offset == 0 — ring hops rotate "
-                "before sharding (tables are indexed by LOCAL row)"
-            )
+        n_q, n_k = q.shape[-2], k.shape[-2]
         if rope_cos.shape[-1] * 2 != q.shape[-1]:
             raise ValueError(
                 f"rope tables must be [n, d_head/2]; got {rope_cos.shape} "
                 f"for d_head {q.shape[-1]}"
             )
-        rope = _expand_rope_tables(rope_cos, rope_sin)
+        if rope_cos_k is None:
+            if q_off:
+                raise ValueError(
+                    "fused rope with q_pos_offset != 0 needs explicit k "
+                    "tables (rope_cos_k/rope_sin_k) gathered at the k "
+                    "block's positions — the shared table is indexed by "
+                    "absolute row and only serves both sides at offset 0"
+                )
+            if rope_cos.shape[0] < max(n_q, n_k):
+                raise ValueError(
+                    f"shared rope table has {rope_cos.shape[0]} rows; need "
+                    f">= {max(n_q, n_k)}"
+                )
+            rope_cos_k, rope_sin_k = rope_cos, rope_sin
+        else:
+            if rope_cos_k.shape[-1] != rope_cos.shape[-1]:
+                raise ValueError(
+                    f"k rope tables half-width {rope_cos_k.shape[-1]} != q "
+                    f"tables {rope_cos.shape[-1]}"
+                )
+            # short tables would be silently ZERO-padded by the Pallas
+            # launch (_pad_to), rotating tail rows by cos=0/sin=0
+            if rope_cos.shape[0] < n_q or rope_cos_k.shape[0] < n_k:
+                raise ValueError(
+                    f"rope tables too short: q tables {rope_cos.shape[0]} "
+                    f"rows for n_q={n_q}, k tables {rope_cos_k.shape[0]} "
+                    f"for n_k={n_k}"
+                )
+        cq2, sq2 = _expand_rope_tables(rope_cos[:n_q], rope_sin[:n_q])
+        ck2, sk2 = _expand_rope_tables(rope_cos_k[:n_k], rope_sin_k[:n_k])
+        rope = (cq2, sq2, ck2, sk2)
     squeeze = q.ndim == 2
     if squeeze:
         q, k, v = q[None], k[None], v[None]
@@ -1345,6 +1371,8 @@ def flash_attention(
     q_pos_offset: int = 0,
     rope_cos: jax.Array | None = None,
     rope_sin: jax.Array | None = None,
+    rope_cos_k: jax.Array | None = None,
+    rope_sin_k: jax.Array | None = None,
 ) -> jax.Array:
     """FlashAttention-2 forward (differentiable). q/k/v: [..., S, D].
 
@@ -1373,17 +1401,20 @@ def flash_attention(
     base-2 Pallas path — test ``lse < -1e20``), as the online-softmax
     merge does naturally (exp(lse − x) underflows to exactly 0).
 
-    ``rope_cos``/``rope_sin``: optional [n, d_head/2] per-row tables (the
-    rope cache gathered at the rows' positions, n >= S) — FUSES the
+    ``rope_cos``/``rope_sin``: optional [n >= n_q, d_head/2] per-row tables
+    (the rope cache gathered at the QUERY rows' positions) — FUSES the
     interleaved-pair RoPE rotation of q and k INSIDE the kernels, so the
     projections' output feeds the custom call directly and no rope
     interleave (or its layout preference) ever exists in XLA-land. Q and K
-    gradients are w.r.t. the UNROTATED inputs. Requires n_q == n_k and
-    q_pos_offset == 0 (tables are indexed by local row).
+    gradients are w.r.t. the UNROTATED inputs. ``rope_cos_k``/
+    ``rope_sin_k``: distinct per-row tables for the KEY rows ([n >= n_k,
+    d_head/2]) — required when ``q_pos_offset != 0`` (ring hops: the K
+    block's global positions differ from the queries'); omitted, the q
+    tables serve both sides, which is only valid at offset 0.
     """
     return _folded_call(
         q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset,
-        rope_cos, rope_sin,
+        rope_cos, rope_sin, rope_cos_k, rope_sin_k,
     )[0]
 
 
@@ -1399,6 +1430,8 @@ def flash_attention_with_lse(
     q_pos_offset: int = 0,
     rope_cos: jax.Array | None = None,
     rope_sin: jax.Array | None = None,
+    rope_cos_k: jax.Array | None = None,
+    rope_sin_k: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward returning (O, logsumexp [..., n_q] fp32) — the saved-residual
     contract (reference test digs L out of saved_tensors, test_attention.py:
@@ -1414,9 +1447,9 @@ def flash_attention_with_lse(
     any logaddexp merge weights such rows by exp(lse - x) = 0, and their
     cotangents vanish with the weight.
 
-    ``rope_cos``/``rope_sin`` fuse RoPE into the kernels — see
-    ``flash_attention``."""
+    ``rope_cos``/``rope_sin`` (and the per-hop ``rope_cos_k``/
+    ``rope_sin_k``) fuse RoPE into the kernels — see ``flash_attention``."""
     return _folded_call(
         q, k, v, causal, impl, q_tile, k_tile, window, q_pos_offset,
-        rope_cos, rope_sin,
+        rope_cos, rope_sin, rope_cos_k, rope_sin_k,
     )

@@ -63,6 +63,9 @@ def ring_attention_with_lse(
     impl: str = "auto",
     q_tile: int = DEFAULT_Q_TILE,
     k_tile: int = DEFAULT_K_TILE,
+    rope_cos: jax.Array | None = None,
+    rope_sin: jax.Array | None = None,
+    positions: jax.Array | None = None,
 ):
     """→ (O [B, S_local, D], L [B, S_local] fp32) for this device's queries.
 
@@ -74,15 +77,35 @@ def ring_attention_with_lse(
     window are skipped entirely (no ppermute, no compute).
     ``impl``: flash impl per hop ("auto" = Pallas kernel on TPU, portable
     scan tiling elsewhere).
+
+    FUSED ROPE over the ring: pass the GLOBAL half-width rope cache
+    (``rope_cos``/``rope_sin`` [S_global, D/2], replicated) plus this
+    shard's global row ``positions`` [S_local], and q/k as the UNROTATED
+    projection outputs. Each hop's kernel rotates in VMEM with q tables
+    gathered at ``positions`` and k tables at ``(positions − t·S_local)
+    mod S_global`` (hop t's block global rows — the shard offset is
+    already inside ``positions``, so no axis_index arithmetic is needed;
+    the mod makes wrapped blocks' tables correct, which non-causal rings
+    rely on and causal rings discard via the lse = −inf merge weight).
+    Gradients are w.r.t. the unrotated q/k, exactly like the
+    single-device fused-rope path.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if rope_cos is not None and positions is None:
+        raise ValueError("fused rope over the ring needs the shard's global "
+                         "row positions")
     if axis_size is None:
         axis_size = jax.lax.axis_size(axis)
     w = int(axis_size)
     b, s_local, d = q.shape
     idx = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % w) for i in range(w)]  # send my block to the right
+
+    if rope_cos is not None:
+        q_tab = (jnp.take(rope_cos, positions, 0), jnp.take(rope_sin, positions, 0))
+    else:
+        q_tab = None
 
     # Number of hops that can contribute to ANY query: under a window,
     # blocks more than ceil((window-1)/S_local) hops back are entirely
@@ -93,15 +116,30 @@ def ring_attention_with_lse(
         hops = min(w, -(-(max(window, 1) - 1) // s_local) + 1)
 
     def attend(t, q, kb, vb):
+        rope_kw = {}
+        if q_tab is not None:
+            # hop t's k block holds global rows positions − t·S_local,
+            # wrapped modulo S_global: non-causal rings genuinely attend
+            # the wrapped-around blocks, and on causal rings the wrapped
+            # hops are discarded anyway so the (correct) wrapped tables
+            # are harmless there.
+            k_pos = (positions - t * s_local) % (w * s_local)
+            rope_kw = dict(
+                rope_cos=q_tab[0], rope_sin=q_tab[1],
+                rope_cos_k=jnp.take(rope_cos, k_pos, 0),
+                rope_sin_k=jnp.take(rope_sin, k_pos, 0),
+            )
         if causal:
             # Hop t's keys sit t whole shards behind the queries: mask at
             # the static global offset (t = 0 is the local causal diagonal).
             return flash_attention_with_lse(
                 q, kb, vb, causal=True, impl=impl, q_tile=q_tile,
                 k_tile=k_tile, window=window, q_pos_offset=t * s_local,
+                **rope_kw,
             )
         return flash_attention_with_lse(
-            q, kb, vb, causal=False, impl=impl, q_tile=q_tile, k_tile=k_tile
+            q, kb, vb, causal=False, impl=impl, q_tile=q_tile, k_tile=k_tile,
+            **rope_kw,
         )
 
     # Hop 0 attends the local block with no communication; each later hop
@@ -145,8 +183,12 @@ def ring_attention_with_lse(
 
 def ring_attention(q, k, v, axis: str, causal: bool = True,
                    axis_size: int | None = None,
-                   window: int | None = None, impl: str = "auto") -> jax.Array:
+                   window: int | None = None, impl: str = "auto",
+                   rope_cos: jax.Array | None = None,
+                   rope_sin: jax.Array | None = None,
+                   positions: jax.Array | None = None) -> jax.Array:
     out, _ = ring_attention_with_lse(
-        q, k, v, axis, causal, axis_size, window=window, impl=impl
+        q, k, v, axis, causal, axis_size, window=window, impl=impl,
+        rope_cos=rope_cos, rope_sin=rope_sin, positions=positions,
     )
     return out

@@ -462,7 +462,8 @@ def test_fused_rope_pallas_bwd_matches_recompute(kernel, causal):
 
     q, k, v = _make_qkv(jax.random.PRNGKey(22), 2, 256, 256, 64)
     cos, sin = rope_cache(256, 64)
-    rope = _expand_rope_tables(cos, sin)
+    # internal 4-tuple convention (_folded_call): q tables then k tables
+    rope = _expand_rope_tables(cos, sin) * 2
     o, lse = _flash_fwd_reference(q, k, v, causal, 128, 128, rope=rope)
     do = jax.random.normal(jax.random.PRNGKey(23), o.shape, o.dtype)
     want = _flash_bwd_recompute(q, k, v, o, lse, do, None, causal, rope=rope)
@@ -550,3 +551,77 @@ def test_pick_group_caps_fp32_narrow_head():
     assert _pick_group(8, 128, 128, 16, 4) <= 2   # fp32, d=16: capped
     assert _pick_group(8, 128, 128, 16, 2) == 4   # bf16, d=16: uncapped
     assert _pick_group(8, 128, 128, 64, 4) == 4   # fp32, d=64: uncapped
+
+
+@pytest.mark.parametrize("impl", ["reference", "pallas"])
+def test_fused_rope_distinct_k_tables_at_ring_offset(impl):
+    """A ring hop attends a K block sitting q_pos_offset positions behind
+    the local queries: fused rope must rotate q rows at their global
+    positions and k rows at the BLOCK's positions (distinct tables). Oracle:
+    rotate in XLA (models.layers.apply_rope) then flash without rope. Both
+    the forward pair and the (unrotated-input) gradients must match."""
+    from cs336_systems_tpu.models.layers import apply_rope, rope_cache
+    from cs336_systems_tpu.ops.flash_attention import flash_attention_with_lse
+
+    s, d, q_off = 128, 64, 128
+    q, k, v = _make_qkv(jax.random.PRNGKey(31), 3, s, s, d)
+    cos, sin = rope_cache(512, d)
+    q_pos = jnp.arange(q_off, q_off + s)
+    k_pos = jnp.arange(s)
+
+    def fused(q, k, v):
+        return flash_attention_with_lse(
+            q, k, v, causal=True, impl=impl, q_tile=128, k_tile=128,
+            q_pos_offset=q_off,
+            rope_cos=jnp.take(cos, q_pos, 0), rope_sin=jnp.take(sin, q_pos, 0),
+            rope_cos_k=jnp.take(cos, k_pos, 0), rope_sin_k=jnp.take(sin, k_pos, 0),
+        )
+
+    def oracle(q, k, v):
+        qr = apply_rope(q, cos, sin, q_pos)
+        kr = apply_rope(k, cos, sin, k_pos)
+        return flash_attention_with_lse(
+            qr, kr, v, causal=True, impl="reference", q_tile=128, k_tile=128,
+            q_pos_offset=q_off,
+        )
+
+    o_got, lse_got = fused(q, k, v)
+    o_want, lse_want = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(o_got), np.asarray(o_want),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lse_got), np.asarray(lse_want),
+                               rtol=1e-4, atol=1e-4)
+
+    loss = lambda f: lambda q, k, v: jnp.sum(jnp.tanh(f(q, k, v)[0]))
+    g_got = jax.grad(loss(fused), argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-3, atol=5e-3,
+                                   err_msg=f"d{name} ({impl})")
+
+
+def test_fused_rope_offset_without_k_tables_raises():
+    from cs336_systems_tpu.models.layers import rope_cache
+    from cs336_systems_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(32), 2, 64, 64, 32)
+    cos, sin = rope_cache(256, 32)
+    with pytest.raises(ValueError, match="explicit k "):
+        flash_attention(q, k, v, causal=True, q_pos_offset=64,
+                        rope_cos=cos, rope_sin=sin)
+
+
+def test_fused_rope_short_explicit_tables_raise():
+    """Explicit k-table path must reject tables shorter than the row
+    counts — the Pallas launch would silently ZERO-pad them (rotating
+    tail rows by cos=0/sin=0)."""
+    from cs336_systems_tpu.models.layers import rope_cache
+    from cs336_systems_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(33), 2, 128, 128, 32)
+    cos, sin = rope_cache(256, 32)
+    with pytest.raises(ValueError, match="too short"):
+        flash_attention(q, k, v, causal=True, q_pos_offset=128,
+                        rope_cos=cos[:100], rope_sin=sin[:100],
+                        rope_cos_k=cos[:128], rope_sin_k=sin[:128])

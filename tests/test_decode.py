@@ -62,10 +62,10 @@ def test_prefill_matches_stepwise(params):
     np.testing.assert_allclose(
         np.asarray(logits_p), np.asarray(logits), rtol=1e-5, atol=1e-6
     )
-    for k in ("k", "v"):
-        np.testing.assert_allclose(
-            np.asarray(cache_p[k]), np.asarray(cache[k]), rtol=1e-5, atol=1e-6
-        )
+    np.testing.assert_allclose(
+        np.asarray(cache_p["kv"]), np.asarray(cache["kv"]),
+        rtol=1e-5, atol=1e-6,
+    )
 
 
 def test_generate_kv_matches_uncached_generate(params):
@@ -270,31 +270,77 @@ def test_generate_kv_crosses_attend_bucket_boundary():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_pallas_decode_attention_matches_masked_softmax():
-    """The fused decode kernel (ops/decode_attention.py, interpret mode on
-    CPU) must match the masked-softmax path at every fill position class:
-    start, mid, full, windowed, and the non-128 head dim (d_head=80 is the
-    2.7b config)."""
-    from cs336_systems_tpu.models.decode import _cached_attention
+def test_fused_update_kernel_matches_xla_path():
+    """The fused update+attend kernel (ops/decode_attention.py, interpret
+    mode on CPU) must match the portable DUS + masked-softmax path: same
+    attention output AND the same updated cache, at every fill-position
+    class — tile-aligned and not (pos % 8), first, last, windowed, and the
+    non-128-pack head dim (d_head=80 is the 2.7b config)."""
+    from cs336_systems_tpu.models.decode import _attend_update_xla
+    from cs336_systems_tpu.ops.decode_attention import (
+        decode_attention_update,
+        pack_kv,
+    )
 
     key = jax.random.PRNGKey(5)
     for b, h, s, d, pos, window in [
         (2, 4, 64, 32, 0, None),
         (2, 4, 64, 32, 17, None),
         (2, 4, 64, 32, 63, None),
+        (2, 4, 64, 32, 24, None),
         (3, 2, 128, 64, 100, 16),
         (1, 2, 64, 80, 40, None),
     ]:
-        kq, kk, kv, key = jax.random.split(key, 4)
+        kq, kk, kv, kn1, kn2, key = jax.random.split(key, 6)
         q = jax.random.normal(kq, (b, h, 1, d))
-        k = jax.random.normal(kk, (b, h, s, d))
-        v = jax.random.normal(kv, (b, h, s, d))
-        want = _cached_attention(q, k, v, jnp.int32(pos), window, impl="xla")
-        got = _cached_attention(q, k, v, jnp.int32(pos), window, impl="pallas")
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5,
-            err_msg=f"b={b} h={h} s={s} d={d} pos={pos} window={window}",
+        kvc = pack_kv(jax.random.normal(kk, (b, h, s, d)),
+                      jax.random.normal(kv, (b, h, s, d)))
+        k_new = jax.random.normal(kn1, (b, h, 1, d))
+        v_new = jax.random.normal(kn2, (b, h, 1, d))
+        want_o, want_kv = _attend_update_xla(
+            q, kvc, k_new, v_new, jnp.int32(pos), window
         )
+        got_o, got_kv = decode_attention_update(
+            q, k_new, v_new, kvc, jnp.int32(pos), window=window
+        )
+        msg = f"b={b} h={h} s={s} d={d} pos={pos} window={window}"
+        np.testing.assert_allclose(
+            np.asarray(got_o), np.asarray(want_o), rtol=1e-5, atol=1e-5,
+            err_msg=msg,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_kv), np.asarray(want_kv), err_msg=msg
+        )
+
+
+def test_fused_update_kernel_attend_len_prefix():
+    """attend_len bounds the streamed prefix without changing the result
+    (all attended rows < attend_len) and the write-back still lands in the
+    full-size cache."""
+    from cs336_systems_tpu.models.decode import _attend_update_xla
+    from cs336_systems_tpu.ops.decode_attention import (
+        decode_attention_update,
+        pack_kv,
+    )
+
+    b, h, s, d, pos, attend = 2, 2, 128, 32, 50, 64
+    key = jax.random.PRNGKey(9)
+    kq, kk, kv, kn1, kn2 = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (b, h, 1, d))
+    kvc = pack_kv(jax.random.normal(kk, (b, h, s, d)),
+                  jax.random.normal(kv, (b, h, s, d)))
+    k_new = jax.random.normal(kn1, (b, h, 1, d))
+    v_new = jax.random.normal(kn2, (b, h, 1, d))
+    want_o, want_kv = _attend_update_xla(
+        q, kvc, k_new, v_new, jnp.int32(pos), None, attend
+    )
+    got_o, got_kv = decode_attention_update(
+        q, k_new, v_new, kvc, jnp.int32(pos), attend_len=attend
+    )
+    np.testing.assert_allclose(np.asarray(got_o), np.asarray(want_o),
+                               rtol=1e-5, atol=1e-5)
+    assert got_kv.shape == kvc.shape
+    np.testing.assert_array_equal(np.asarray(got_kv), np.asarray(want_kv))
 
 
 def test_generate_kv_pallas_attention_matches_xla(params):
@@ -347,23 +393,26 @@ def test_cached_attention_impl_validation_and_vmem_fallback():
     attn_impl); 'auto' falls back to masked-softmax when the attended
     prefix exceeds the kernel's VMEM slab plan, and the kernel itself
     refuses such prefixes rather than OOMing Mosaic."""
-    from cs336_systems_tpu.models.decode import _cached_attention
+    from cs336_systems_tpu.models.decode import _resolve_impl
     from cs336_systems_tpu.ops import decode_attention as da
 
-    q = jnp.zeros((1, 2, 1, 64))
-    k = jnp.zeros((1, 2, 64, 64))
     with pytest.raises(ValueError, match="serving-kernel"):
-        _cached_attention(q, k, k, jnp.int32(3), impl="flash")
+        _resolve_impl("flash", 256, 64, 2)
 
     assert da.supported(4096, 64, 2)
     assert not da.supported(32768, 64, 2)
-    big = jnp.zeros((1, 1, 32768, 64), jnp.bfloat16)
+    big = jnp.zeros((1, 1, 32768, 128), jnp.bfloat16)
+    one = jnp.zeros((1, 1, 1, 64), jnp.bfloat16)
     with pytest.raises(ValueError, match="VMEM slab plan"):
-        da.decode_attention(jnp.zeros((1, 1, 1, 64), jnp.bfloat16),
-                            big, big, jnp.int32(5))
-    # auto on the same shape routes through xla without error
-    out = _cached_attention(
-        jnp.zeros((1, 1, 1, 64), jnp.bfloat16), big, big, jnp.int32(5),
-        impl="auto",
-    )
-    assert out.shape == (1, 1, 1, 64)
+        da.decode_attention_update(one, one, one, big, jnp.int32(5))
+    # auto on the same prefix routes to xla without error
+    assert _resolve_impl("auto", 32768, 64, 2) == "xla"
+
+
+def test_resolve_impl_requires_aligned_prefix():
+    """'auto' must route non-8-aligned attended prefixes to xla (the
+    kernel's write-back tile needs 8-row alignment) rather than letting
+    the kernel raise mid-trace."""
+    from cs336_systems_tpu.models.decode import _resolve_impl
+
+    assert _resolve_impl("auto", 1020, 64, 2) == "xla"
