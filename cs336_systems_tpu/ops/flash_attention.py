@@ -57,8 +57,16 @@ from jax.experimental.pallas import tpu as pltpu
 # the Mosaic grid to 1/16th of the 128×128 choice — measured 3× faster at
 # S=512 on v5e (grid-step overhead, not FLOPs, dominates small tiles). The
 # MXU only needs multiples of 128; bigger is better until VMEM pressure.
-DEFAULT_Q_TILE = 512
-DEFAULT_K_TILE = 512
+# 1024 measured best at every S >= 2048 on v5e (chip sweep, d=64 bf16
+# b=8: fwd -24/-31/-35/-54/-54% and fwd+bwd -9/-15/-18/-29/-34% at
+# S=2k/4k/8k/16k/64k vs 512-tiles — long-S grids are Mosaic grid-step
+# bound, and doubling the tile quarters the step count; windowed banded
+# -27% fwd, fp32 and d=128 compile clean). At S <= 512 _pick_tile clamps
+# to S, so the headline compile is unchanged; S=1024 gains the
+# single-k-tile fast path (-33% fwd at the ctx-1024 fold). 2048-tiles
+# fail to compile (VMEM).
+DEFAULT_Q_TILE = 1024
+DEFAULT_K_TILE = 1024
 _NEG_INF = -1e30  # finite fill: exp(_NEG_INF - m) == 0 without NaN risk
 
 # The kernels run the online softmax in BASE 2: scores are scaled by
@@ -135,7 +143,16 @@ def _apply_rope_full(x, cos2, sin2, inverse: bool = False):
 
 
 def _pick_tile(n: int, want: int) -> int:
-    """Largest power-of-two tile <= want that keeps one full tile <= n."""
+    """Largest power-of-two tile <= want that DIVIDES n (down to 128) —
+    divisible tiles keep the tiled backward eligible and the forward
+    unpadded (without this, S=1536 at the 1024 default would fall out of
+    the O(S)-memory tiled backward into the O(S^2) recompute). Lengths
+    with no >=128 power-of-two divisor fall back to the padding clamp."""
+    t = want
+    while t >= 128:
+        if n % t == 0:
+            return t
+        t //= 2
     t = want
     while t > n and t > 8:
         t //= 2

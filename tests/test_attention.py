@@ -625,3 +625,19 @@ def test_fused_rope_short_explicit_tables_raise():
         flash_attention(q, k, v, causal=True, q_pos_offset=128,
                         rope_cos=cos[:100], rope_sin=sin[:100],
                         rope_cos_k=cos[:128], rope_sin_k=sin[:128])
+
+
+def test_pick_tile_prefers_divisors():
+    """The 1024 default must not drop 512-divisible lengths (S=1536,
+    2560, ...) out of the tiled backward: _pick_tile prefers the largest
+    power-of-two tile that DIVIDES the length (>=128) and only falls back
+    to the padding clamp when none exists."""
+    from cs336_systems_tpu.ops.flash_attention import _pick_tile
+
+    assert _pick_tile(1536, 1024) == 512
+    assert _pick_tile(2560, 1024) == 512
+    assert _pick_tile(65536, 1024) == 1024
+    assert _pick_tile(2048, 1024) == 1024
+    assert _pick_tile(512, 1024) == 512   # headline shape: unchanged
+    assert _pick_tile(96, 1024) == 64     # padding fallback unchanged
+    assert _pick_tile(64, 512) == 64
