@@ -990,6 +990,13 @@ def _flash_bwd_pallas_tiled(q, k, v, o, lse, do, dlse, causal: bool,
     n_k = k.shape[1]
     bq = _pick_tile(n_q, q_tile)
     bk = _pick_tile(n_k, k_tile)
+    if rope is not None:
+        # fused rope adds 4 fp32 table blocks + per-step rotation
+        # temporaries; at 1024-tiles the per-grid-step footprint overflows
+        # scoped VMEM (measured on chip: 18.32M > the 16M limit at
+        # S=65536 ctx training — the BARE 1024-tile backward fits). The
+        # forward keeps 1024; the backward caps at the known-good 512.
+        bq, bk = min(bq, 512), min(bk, 512)
     tq, tk = n_q // bq, n_k // bk
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

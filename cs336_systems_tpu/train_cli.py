@@ -398,6 +398,11 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true",
                    help="resume params/opt/step from --checkpoint-dir")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise each block in the backward — trades "
+                        "~30%% recompute for O(1)-blocks activation memory; "
+                        "required for long contexts (ctx-65536 on one v5e "
+                        "demands ~25 GB of stashes without it)")
     args = p.parse_args(argv)
 
     on_tpu = jax.default_backend() == "tpu"
@@ -420,6 +425,7 @@ def main(argv=None) -> None:
         compute_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         attn_impl=args.attn or ("flash" if on_tpu else "xla"),
         scan_layers=not on_tpu,
+        remat=args.remat,
         **overrides,
     )
     mesh_axes = None
