@@ -57,7 +57,10 @@ def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> fl
     from cs336_systems_tpu.models.decode import _ATTEND_BUCKET, _round_up
 
     d, dff, L, v = cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.vocab_size
-    weight_bytes = (L * (4 * d * d + 3 * d * dff) + d * v) * 2  # bf16
+    # MoE: at serving batch every expert is typically touched each step,
+    # so the honest weight-read bound covers ALL expert tables
+    ffn_mult = max(cfg.num_experts, 1)
+    weight_bytes = (L * (4 * d * d + ffn_mult * 3 * d * dff) + d * v) * 2  # bf16
     alloc = min(_round_up(prompt_len + new_tokens, _ATTEND_BUCKET),
                 cfg.context_length)
     h, dh = cfg.num_heads, cfg.d_head
@@ -76,6 +79,8 @@ def benchmark_decode(
     batch_sizes: tuple[int, ...] = (),
     uncached: bool = True,
     reps: int = 3,
+    experts: int = 0,
+    moe_top_k: int = 2,
 ) -> list[dict]:
     from cs336_systems_tpu.models.decode import (
         generate_kv,
@@ -94,10 +99,14 @@ def benchmark_decode(
         # attn_impl arg, default "auto" = the fused Pallas decode kernel
         # on TPU, masked-softmax elsewhere — models/decode._decode_block)
         attn_impl="xla",
+        **({"num_experts": experts, "moe_top_k": moe_top_k} if experts else {}),
     )
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
     prompt = list(range(1, prompt_len + 1))
     key = jax.random.PRNGKey(7)
+    # machine-readable config marker so MoE rows can never be conflated
+    # with dense rows in a merged table
+    moe_tag = f"_moe{experts}k{moe_top_k}" if experts else ""
     rows = []
 
     # KV-cache path: whole generation in one jit
@@ -109,7 +118,7 @@ def benchmark_decode(
     )
     rows.append(
         {
-            "path": "kv_cache",
+            "path": f"kv_cache{moe_tag}",
             "prompt": prompt_len,
             "new_tokens": new_tokens,
             "total_ms": round(dt * 1e3, 1),
@@ -129,7 +138,7 @@ def benchmark_decode(
     )
     rows.append(
         {
-            "path": "prefill_only",
+            "path": f"prefill_only{moe_tag}",
             "prompt": prompt_len,
             "new_tokens": 0,
             "total_ms": round(dt_p * 1e3, 1),
@@ -160,7 +169,7 @@ def benchmark_decode(
             dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
             rows.append(
                 {
-                    "path": f"kv_cache_b{b}{tag}",
+                    "path": f"kv_cache_b{b}{tag}{moe_tag}",
                     "prompt": prompt_len,
                     "new_tokens": new_tokens,
                     "total_ms": round(dt_b * 1e3, 1),
@@ -182,7 +191,7 @@ def benchmark_decode(
         )
         rows.append(
             {
-                "path": "uncached_loop",
+                "path": f"uncached_loop{moe_tag}",
                 "prompt": prompt_len,
                 "new_tokens": new_tokens,
                 "total_ms": round(dt_u * 1e3, 1),
@@ -206,6 +215,10 @@ def main(argv=None) -> None:
     p.add_argument("--no-uncached", dest="uncached", action="store_false",
                    help="skip the slow full-forward-per-token baseline")
     p.add_argument("--latex", default=None)
+    p.add_argument("--experts", type=int, default=0,
+                   help="serve a Mixture-of-Experts backbone (E experts, "
+                        "top-k routed per token — models/moe.py)")
+    p.add_argument("--moe-top-k", type=int, default=2)
     args = p.parse_args(argv)
 
     rows = []
@@ -214,7 +227,7 @@ def main(argv=None) -> None:
             size=args.size, prompt_len=args.prompt, new_tokens=new,
             batch_sizes=tuple(args.batches),
             uncached=args.uncached and j == 0,  # the slow baseline once
-            reps=args.reps,
+            reps=args.reps, experts=args.experts, moe_top_k=args.moe_top_k,
         )
     df = results_table(rows, args.latex)
     print_table(df)
