@@ -398,6 +398,10 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", action="store_true",
                    help="resume params/opt/step from --checkpoint-dir")
+    p.add_argument("--window", type=int, default=None,
+                   help="causal sliding-window attention width in tokens "
+                        "(banded Pallas grids: cost scales with the window, "
+                        "not the context)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialise each block in the backward — trades "
                         "~30%% recompute for O(1)-blocks activation memory; "
@@ -426,6 +430,7 @@ def main(argv=None) -> None:
         attn_impl=args.attn or ("flash" if on_tpu else "xla"),
         scan_layers=not on_tpu,
         remat=args.remat,
+        attn_window=args.window,
         **overrides,
     )
     mesh_axes = None

@@ -195,6 +195,13 @@ def test_decode_benchmark_cli_smoke(capsys, monkeypatch):
     for token in ("kv_cache", "prefill_only", "uncached_loop", "ms_per_token"):
         assert token in out, f"missing {token!r} in decode benchmark output"
 
+    # MoE serving path: cfg construction, all-expert roofline, row tag
+    main(["--size", "tiny", "--prompt", "8", "--new", "4", "--reps", "1",
+          "--no-uncached", "--batches", "2", "--experts", "2",
+          "--moe-top-k", "1"])
+    out = capsys.readouterr().out
+    assert "kv_cache_b2_moe2k1" in out
+
 
 def test_summarize_trace(tmp_path):
     """The trace summarizer reads back real profiler output and reports

@@ -56,6 +56,18 @@ def test_cli_parallel_modes_agree(mode, extra, capsys):
     assert 0 < ref < 10
 
 
+def test_cli_window_flag_trains(capsys):
+    """--window plumbs cfg.attn_window through the CLI: the run trains and
+    the windowed loss DIFFERS from full causal (the mask really bites at
+    window < ctx)."""
+    main(TINY + ["--steps", "4"])
+    full = _last_loss(capsys.readouterr().out)
+    main(TINY + ["--steps", "4", "--window", "8"])
+    win = _last_loss(capsys.readouterr().out)
+    assert 0 < win < 10
+    assert win != full
+
+
 def test_cli_ep_mode_trains(capsys):
     """--parallel ep trains an MoE model (different loss surface than the
     dense modes — aux load-balance term — so: finite and decreasing)."""
