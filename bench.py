@@ -47,7 +47,14 @@ def model_flops_per_token(cfg, causal: bool = True) -> float:
     """
     d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
     s = cfg.context_length
-    n_matmul = L * (4 * d * d + 3 * d * dff) + d * cfg.vocab_size
+    # MoE configs: a token's FFN work is its top-k experts (plus the
+    # router matmul); inactive experts do no model FLOPs for it.
+    e = getattr(cfg, "num_experts", 0)
+    ffn_mult = max(getattr(cfg, "moe_top_k", 1), 1) if e else 1
+    n_matmul = (
+        L * (4 * d * d + ffn_mult * 3 * d * dff + d * e)
+        + d * cfg.vocab_size
+    )
     attn = 12 * s * d * L * (0.5 if causal else 1.0)
     return 6 * n_matmul + attn
 

@@ -57,9 +57,18 @@ def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> fl
     from cs336_systems_tpu.models.decode import _ATTEND_BUCKET, _round_up
 
     d, dff, L, v = cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.vocab_size
-    # MoE: at serving batch every expert is typically touched each step,
-    # so the honest weight-read bound covers ALL expert tables
-    ffn_mult = max(cfg.num_experts, 1)
+    # MoE: a step reads only the DISTINCT experts its batch·top_k
+    # assignments touch. min(E, B·k) is the worst case (all distinct) and
+    # flatters frac one way; the expected distinct count under uniform
+    # routing, E·(1 − (1 − 1/E)^(B·k)), is the stated approximation —
+    # exact only for balanced routers, so MoE frac columns carry that
+    # assumption (noted in the emitted artifact header).
+    if cfg.num_experts:
+        draws = max(batch, 1) * max(cfg.moe_top_k, 1)
+        e = cfg.num_experts
+        ffn_mult = e * (1.0 - (1.0 - 1.0 / e) ** draws)
+    else:
+        ffn_mult = 1
     weight_bytes = (L * (4 * d * d + ffn_mult * 3 * d * dff) + d * v) * 2  # bf16
     alloc = min(_round_up(prompt_len + new_tokens, _ATTEND_BUCKET),
                 cfg.context_length)

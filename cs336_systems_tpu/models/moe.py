@@ -41,8 +41,133 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear, swiglu
+from cs336_systems_tpu.ops.grouped_matmul import float0_like as _float0_like
+
+
+def _prefix_count(onehot: jax.Array) -> jax.Array:
+    """Inclusive prefix sum along axis 0 of a [T, E] count matrix, as two
+    tril matmuls on the MXU.
+
+    ``lax.cumsum``'s TPU lowering was the sorted path's single largest
+    overhead at the E8k2 peak: 2.1 ms per [16384, 8] call, 27.5 ms/step
+    across the routing (round-4 trace, scripts/trace_moe_step.py) — the
+    reduce-window form is O(T·window) on the VPU. Blocked form: within-
+    block prefix via a [b, b] tril dot, block offsets via an exclusive
+    tril dot over the [T/b] block sums — ~16 M MACs at T=16384, MXU work
+    measured at noise level. Exact: counts < 2^24 held in fp32.
+    """
+    t, e = onehot.shape
+    b = 128
+    pad = (-t) % b
+    x = onehot.astype(jnp.float32)
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, e), jnp.float32)])
+    tb = x.shape[0] // b
+    x = x.reshape(tb, b, e)
+    tril = jnp.tril(jnp.ones((b, b), jnp.float32))
+    within = jnp.einsum("ij,bje->bie", tril, x)  # inclusive, within block
+    offs = jnp.einsum(
+        "ij,je->ie", jnp.tril(jnp.ones((tb, tb), jnp.float32), -1),
+        within[:, -1, :],
+    )  # exclusive cumsum of block totals
+    out = (within + offs[:, None, :]).reshape(-1, e)[:t]
+    return out.astype(onehot.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Gather-both-ways claim movement (the round-4 sorted dispatch)
+#
+# The round-3 sorted path moved rows with an XLA scatter into [E, C, D] and
+# a 2-D-index gather back; its backward then scattered again. Row scatters
+# never run well here, and the fp32 combine staged two 50 MB temporaries per
+# layer — enough HBM pressure that XLA rematerialized ~20 ms/step of
+# converts (round-4 trace). With BOTH index maps materialized (claim→slot
+# ``dest`` and slot→claim ``src``), every direction — forward dispatch,
+# forward combine, and both backwards — is a row GATHER; the only scatter
+# left in the layer is one [E·C] int32 scalar scatter building ``src``.
+
+
+@jax.custom_vjp
+def _dispatch_rows(xt, tok_of_slot, valid, dest_c, keep):
+    """xe_flat[s] = valid[s] ? xt[token(src[s])] : 0 — [E·C, D] from [T, D].
+
+    ``dest_c``/``keep`` ([T·k], clamped slot of each claim / kept mask) are
+    unused in the forward; they make the TRANSPOSE a gather: dxt[t] =
+    Σ_kept-claims-of-t dxe[dest]. Slots are unique per claim, so this is
+    the exact adjoint of the forward's (valid, src) gather.
+    """
+    del dest_c, keep
+    return jnp.where(valid[:, None], jnp.take(xt, tok_of_slot, axis=0), 0)
+
+
+def _dispatch_rows_fwd(xt, tok_of_slot, valid, dest_c, keep):
+    out = _dispatch_rows(xt, tok_of_slot, valid, dest_c, keep)
+    res = (dest_c, keep, xt.shape[0], tok_of_slot, valid)
+    return out, res
+
+
+def _dispatch_rows_bwd(res, g):
+    dest_c, keep, t, tok_of_slot, valid = res
+    k = dest_c.size // t
+    picked = jnp.take(g, dest_c, axis=0)  # [T·k, D]
+    picked = jnp.where(keep[:, None], picked, 0)
+    dxt = jnp.sum(picked.reshape(t, k, -1), axis=1)
+    return (dxt, _float0_like(tok_of_slot), _float0_like(valid),
+            _float0_like(dest_c), _float0_like(keep))
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(ye_flat, wk, dest_c, src_c, valid, tok_of_slot):
+    """Combined token outputs: [T, D] fp32, out[t] = Σ_j wk[t,j] ·
+    ye_flat[dest_c[t,j]]. The k-sum lives INSIDE so the gather, the
+    weight multiply, and the reduction fuse into one pass — per-claim
+    [T·k, D] fp32 rows never hit HBM (they were ~30 ms/step of combine
+    glue at the E8k2 b32 cell when materialized).
+
+    ``wk``/``dest_c`` are [T, k]. CONTRACT: ``wk`` MUST be the
+    kept-masked weight (weight · keep) when claims can drop — a dropped
+    claim's ``dest_c`` is clamped to 0, so its raw d_wk here is the
+    nonzero <g[t], ye_flat[0]>; the keep-product's own chain rule is
+    what zeroes the router-gate gradient. Passing unmasked weights with
+    drops would contaminate router gradients silently. The backward
+    gathers in both directions: d_ye via the slot→claim map
+    (src_c/valid/tok_of_slot), d_wk via the claim→slot map (dest_c).
+    """
+    del src_c, valid, tok_of_slot
+    t, k = wk.shape
+    d = ye_flat.shape[-1]
+    rows = jnp.take(ye_flat, dest_c.reshape(-1), axis=0).astype(jnp.float32)
+    return jnp.sum(rows.reshape(t, k, d) * wk[..., None], axis=1)
+
+
+def _combine_rows_fwd(ye_flat, wk, dest_c, src_c, valid, tok_of_slot):
+    out = _combine_rows(ye_flat, wk, dest_c, src_c, valid, tok_of_slot)
+    return out, (ye_flat, wk, dest_c, src_c, valid, tok_of_slot)
+
+
+def _combine_rows_bwd(res, g):
+    ye_flat, wk, dest_c, src_c, valid, tok_of_slot = res
+    t, k = wk.shape
+    # g: [T, D] fp32. d_ye[s] = valid[s] · wk[claim(s)] · g[token(s)] —
+    # slot s is filled by claim src_c[s] alone, so the adjoint of the dest
+    # gather is this src/token gather.
+    ws = jnp.take(wk.reshape(-1), src_c)
+    gs = jnp.take(g, tok_of_slot, axis=0)
+    d_ye = jnp.where(valid[:, None], ws[:, None] * gs, 0).astype(ye_flat.dtype)
+    # d_wk[t,j] = <g[t], ye_flat[dest_c[t,j]]> — both sides gathers.
+    rows = jnp.take(ye_flat, dest_c.reshape(-1), axis=0).astype(jnp.float32)
+    d_wk = jnp.sum(rows.reshape(t, k, -1) * g[:, None, :], axis=-1)
+    return (d_ye, d_wk, _float0_like(dest_c), _float0_like(src_c),
+            _float0_like(valid), _float0_like(tok_of_slot))
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
 def init_moe(key, d_model: int, d_ff: int, num_experts: int, dtype=jnp.float32):
@@ -82,7 +207,7 @@ def route_topk(gates: jax.Array, top_k: int, capacity: int):
     for j in range(top_k):  # top_k is small and static
         onehot_e = jax.nn.one_hot(idx[:, j], e, dtype=jnp.float32)  # [T, E]
         # position this token would take in each expert's queue
-        pos_if = jnp.cumsum(onehot_e, axis=0) - 1.0 + fill[None, :].astype(jnp.float32)
+        pos_if = _prefix_count(onehot_e) - 1.0 + fill[None, :].astype(jnp.float32)
         pos = jnp.sum(pos_if * onehot_e, axis=-1)  # [T]
         keep = (pos < capacity) & (pos >= 0)
         slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
@@ -135,7 +260,7 @@ def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
         else:
             offset = fill
             fill = fill + local_count
-        pos_if = jnp.cumsum(onehot, axis=0) - 1 + offset[None, :]
+        pos_if = _prefix_count(onehot) - 1 + offset[None, :]
         pos_cols.append(jnp.sum(pos_if * onehot, axis=-1))  # [T]
     pos = jnp.stack(pos_cols, axis=1)  # [T, k]
 
@@ -157,8 +282,14 @@ def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
 
 
 def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
-                    dp_axis: str | None):
-    """Scatter/gather dispatch (see module docstring). xt: [T, D]."""
+                    dp_axis: str | None, scatter_rows: bool = False,
+                    ffn_remat: bool = False):
+    """Index dispatch (see module docstring). xt: [T, D].
+
+    Default is the round-4 gather-both-ways movement (``_dispatch_rows`` /
+    ``_combine_rows``); ``scatter_rows=True`` is the round-3 row-scatter
+    form, kept for the A/B in results/moe_v5e.txt.
+    """
     t, d = xt.shape
     e = params["router"]["weight"].shape[0]
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
@@ -179,40 +310,164 @@ def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
     flat_keep = keep.reshape(-1)
     kept_onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32) * flat_keep[:, None]
     local_rank = jnp.sum(
-        (jnp.cumsum(kept_onehot, axis=0) - kept_onehot) * kept_onehot, axis=-1
+        (_prefix_count(kept_onehot) - kept_onehot) * kept_onehot, axis=-1
     )
-    # dropped claims -> slot c_buf (out of bounds): scatter mode="drop"
-    # discards them, gather mode="fill" reads them as zero
-    slot = jnp.where(flat_keep, local_rank, c_buf)
-
     token = jnp.repeat(jnp.arange(t), top_k)  # claim -> source token
-    xe = (
-        jnp.zeros((e, c_buf, d), in_dtype)
-        .at[flat_e, slot]
-        .set(xt.astype(in_dtype)[token], mode="drop")
+
+    expert_fn = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))
+    if ffn_remat:
+        # Recompute the expert hidden activations in the backward instead
+        # of stashing them: the [E, C, d_ff] gate/up pair is the layer's
+        # largest stash (≈150 MB/layer at the E8k2 b24 cell) and costs two
+        # of three expert matmuls to rebuild — the knob that fits larger
+        # batches without full-block remat.
+        expert_fn = jax.checkpoint(expert_fn)
+
+    if scatter_rows:
+        # dropped claims -> slot c_buf (out of bounds): scatter mode="drop"
+        # discards them, gather mode="fill" reads them as zero
+        slot = jnp.where(flat_keep, local_rank, c_buf)
+        xe = (
+            jnp.zeros((e, c_buf, d), in_dtype)
+            .at[flat_e, slot]
+            .set(xt.astype(in_dtype)[token], mode="drop")
+        )
+        ye = expert_fn(params["experts"], xe)
+        back = ye.astype(jnp.float32).at[flat_e, slot].get(
+            mode="fill", fill_value=0.0
+        )  # [T·k, D]
+        out = jnp.sum(
+            back.reshape(t, top_k, d)
+            * (weight * keep.astype(jnp.float32))[..., None],
+            axis=1,
+        )
+        return out.astype(in_dtype), aux
+
+    # Gather-both-ways: materialize claim→slot (dest) AND slot→claim (src);
+    # the src build is the only scatter in the layer and moves int32
+    # scalars, never rows. Dropped claims get unique out-of-bounds dests so
+    # unique_indices holds for the drop-mode scatter.
+    flat_rank = jnp.arange(t * top_k, dtype=jnp.int32)
+    dest = flat_e * c_buf + local_rank
+    dest_scatter = jnp.where(flat_keep, dest, e * c_buf + flat_rank)
+    dest_c = jnp.where(flat_keep, dest, 0)
+    src = (
+        jnp.full((e * c_buf,), -1, jnp.int32)
+        .at[dest_scatter]
+        .set(flat_rank, mode="drop", unique_indices=True)
     )
-    ye = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))(params["experts"], xe)
-    back = ye.astype(jnp.float32).at[flat_e, slot].get(
-        mode="fill", fill_value=0.0
-    )  # [T·k, D]
-    out = jnp.sum(
-        back.reshape(t, top_k, d)
-        * (weight * keep.astype(jnp.float32))[..., None],
-        axis=1,
+    valid = src >= 0
+    src_c = jnp.where(valid, src, 0)
+    tok_of_slot = jnp.take(token, src_c)
+
+    xe_flat = _dispatch_rows(
+        xt.astype(in_dtype), tok_of_slot, valid, dest_c, flat_keep
+    )
+    dest_c = dest_c.reshape(t, top_k)
+    ye = expert_fn(params["experts"], xe_flat.reshape(e, c_buf, d))
+    wk = weight * keep.astype(jnp.float32)  # [T, k]
+    out = _combine_rows(
+        ye.reshape(e * c_buf, d), wk, dest_c, src_c, valid, tok_of_slot
+    )
+    return out.astype(in_dtype), aux
+
+
+def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
+                 ffn_remat: bool, bm: int = 128):
+    """DROPLESS dispatch over the Pallas grouped matmul
+    (ops/grouped_matmul.py): tokens packed tightly by expert (per-group
+    pad only to the ``bm`` row tile, ~3% at the E8k2 peak vs the capacity
+    form's cf−1 = 25%), every claim computed — capacity never drops.
+    Routing probabilities/aux are identical to the capacity paths; under
+    ``dp_axis`` the only cross-shard work is the aux loss's pmean (nothing
+    drops, so per-shard compute already equals the full-batch model —
+    routing runs locally, no fill-position all-gathers).
+    """
+    from cs336_systems_tpu.ops.grouped_matmul import grouped_matmul, tile_maps
+
+    t, d = xt.shape
+    e = params["router"]["weight"].shape[0]
+    in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+
+    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    # Route LOCALLY even under dp (dropless compute needs no cross-shard
+    # fill positions — route_topk_indexed's [W, E] all-gathers would buy
+    # nothing); only the aux loss takes the global-mean form below.
+    expert, pos, weight, aux = route_topk_indexed(
+        gates, top_k, t * top_k, None
+    )
+    if dp_axis is not None:
+        top1 = jax.nn.one_hot(expert[:, 0], e, dtype=jnp.float32)
+        m_g = jax.lax.pmean(jnp.mean(gates, axis=0), dp_axis)
+        m_t = jax.lax.pmean(jnp.mean(top1, axis=0), dp_axis)
+        aux = e * jnp.sum(m_g * m_t)  # same global form as route_topk_indexed
+
+    flat_e = expert.reshape(-1)
+    counts = jnp.sum(jax.nn.one_hot(flat_e, e, dtype=jnp.int32), axis=0)
+    # Dropless routing makes ``pos`` a bijective 0..count-1 fill rank
+    # within each expert already — no prefix recompute needed (the
+    # capacity paths re-rank because drops puncture the sequence).
+    local_rank = pos.reshape(-1)
+    # static row budget covering Σ round_up(counts, bm) in whole tiles
+    m_pad = (-(-(t * top_k) // bm) + e) * bm
+    te, first, visited, starts = tile_maps(counts, bm, m_pad // bm)
+
+    token = jnp.repeat(jnp.arange(t), top_k)
+    flat_rank = jnp.arange(t * top_k, dtype=jnp.int32)
+    dest = jnp.take(starts, flat_e) + local_rank  # tight packed row
+    src = (
+        jnp.full((m_pad,), -1, jnp.int32)
+        .at[dest]
+        .set(flat_rank, mode="drop", unique_indices=True)
+    )
+    valid = src >= 0
+    src_c = jnp.where(valid, src, 0)
+    tok_of_slot = jnp.take(token, src_c)
+    all_keep = jnp.ones_like(flat_e, dtype=bool)
+
+    xs = _dispatch_rows(
+        xt.astype(in_dtype), tok_of_slot, valid, dest, all_keep
+    )
+
+    def expert_ffn(wp, xs):
+        # grouped_matmul consumes the native [E, out, in] layers.linear
+        # layout directly (its kernels pick contracting dims) — only the
+        # bf16 cast materializes, same as the capacity paths.
+        cast = lambda a: a.astype(in_dtype)
+        h = grouped_matmul(xs, cast(wp["w1"]["weight"]), te, first, visited, bm)
+        g = grouped_matmul(xs, cast(wp["w3"]["weight"]), te, first, visited, bm)
+        p = (jax.nn.silu(h) * g).astype(in_dtype)
+        return grouped_matmul(p, cast(wp["w2"]["weight"]), te, first, visited, bm)
+
+    if ffn_remat:
+        expert_ffn = jax.checkpoint(expert_ffn)
+    ys = expert_ffn(params["experts"], xs)
+
+    out = _combine_rows(
+        ys, weight, dest.reshape(t, top_k), src_c, valid, tok_of_slot
     )
     return out.astype(in_dtype), aux
 
 
 def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
             compute_dtype=None, dispatch: str = "dense",
-            dp_axis: str | None = None, global_tokens: int | None = None):
+            dp_axis: str | None = None, global_tokens: int | None = None,
+            ffn_remat: bool = False):
     """MoE SwiGLU: [..., S, D] -> ([..., S, D], aux loss scalar).
 
-    ``dispatch``: "dense" (one-hot einsums) or "sorted" (index scatter /
-    gather) — same routing decisions, different data movement (module
-    docstring). ``dp_axis`` (sorted only): full-batch-consistent routing
-    under data parallelism; ``global_tokens`` overrides the token count
-    used for capacity (defaults to T · axis size).
+    ``dispatch``: "dense" (one-hot einsums), "sorted" (index dispatch,
+    gather-both-ways row movement), "sorted_scatter" (the round-3
+    row-scatter form of "sorted", kept for A/B), or "gmm" (DROPLESS —
+    tokens packed tightly by expert and computed by the Pallas grouped
+    matmul, ops/grouped_matmul.py; ``capacity_factor`` is ignored, no
+    claim ever drops). The capacity schemes share routing decisions;
+    "gmm" shares routing probabilities but never drops. ``dp_axis``
+    (sorted/gmm): full-batch-consistent routing under data parallelism
+    (for "gmm" only the aux loss needs the global form — dropless
+    per-shard compute already matches the full batch);
+    ``global_tokens`` overrides the token count used for capacity
+    (defaults to T · axis size).
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
@@ -220,13 +475,22 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
     t = xt.shape[0]
     e = params["router"]["weight"].shape[0]
 
-    if dispatch == "sorted":
+    if dispatch == "gmm":
+        out, aux = _moe_ffn_gmm(
+            params, xt, top_k, compute_dtype, dp_axis, ffn_remat
+        )
+        return out.reshape(*lead, d), aux
+    if dispatch in ("sorted", "sorted_scatter"):
         if dp_axis is not None:
             t_cap = global_tokens or t * jax.lax.axis_size(dp_axis)
         else:
             t_cap = t
         c = moe_capacity(t_cap, e, top_k, capacity_factor)
-        out, aux = _moe_ffn_sorted(params, xt, top_k, c, compute_dtype, dp_axis)
+        out, aux = _moe_ffn_sorted(
+            params, xt, top_k, c, compute_dtype, dp_axis,
+            scatter_rows=dispatch == "sorted_scatter",
+            ffn_remat=ffn_remat,
+        )
         return out.reshape(*lead, d), aux
     if dp_axis is not None:
         raise ValueError(
@@ -247,7 +511,10 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
         preferred_element_type=jnp.float32,
     ).astype(in_dtype)  # [E, C, D]
 
-    ye = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))(params["experts"], xe)
+    expert_fn = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))
+    if ffn_remat:
+        expert_fn = jax.checkpoint(expert_fn)  # see _moe_ffn_sorted
+    ye = expert_fn(params["experts"], xe)
 
     out = jnp.einsum(
         "tec,ecd->td", combine.astype(jnp.float32), ye.astype(jnp.float32),

@@ -107,11 +107,18 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance aux loss weight in lm_loss
-    # "dense" one-hot einsum dispatch or "sorted" scatter/gather dispatch
-    # (see models/moe.py); "sorted" + moe_dp_axis gives full-batch-
-    # consistent routing under data parallelism (set by the DP builder).
+    # "dense" one-hot einsum dispatch, "sorted" gather-both-ways index
+    # dispatch, or "sorted_scatter" (the round-3 row-scatter form, kept
+    # for A/B — see models/moe.py); "sorted" + moe_dp_axis gives
+    # full-batch-consistent routing under data parallelism (set by the DP
+    # builder).
     moe_dispatch: str = "dense"
     moe_dp_axis: str | None = None
+    # Recompute the expert FFN hidden activations in the backward (the
+    # [E, C, d_ff] gate/up stash, the MoE layer's largest) — a selective
+    # remat far cheaper than cfg.remat's whole-block recompute; it is what
+    # fits the larger sorted-dispatch batches on one chip (moe_v5e.txt).
+    moe_ffn_remat: bool = False
 
     def __post_init__(self):
         if self.d_model % self.num_heads != 0:
@@ -138,12 +145,16 @@ class TransformerConfig:
                 "attn_fold='hb' is a single-device layout optimization; "
                 "the sharded attention paths use the 'bh' fold"
             )
-        if self.moe_dispatch not in ("dense", "sorted"):
+        if self.moe_dispatch not in ("dense", "sorted", "sorted_scatter",
+                                     "gmm"):
             raise ValueError(f"unknown moe_dispatch: {self.moe_dispatch!r}")
-        if self.moe_dp_axis is not None and self.moe_dispatch != "sorted":
+        if self.moe_dp_axis is not None and self.moe_dispatch not in (
+            "sorted", "sorted_scatter", "gmm"
+        ):
             raise ValueError(
-                "moe_dp_axis (DP-consistent routing) requires "
-                "moe_dispatch='sorted'"
+                "moe_dp_axis (DP-consistent routing) requires an indexed "
+                "dispatch: 'sorted', 'sorted_scatter', or 'gmm' (the dense "
+                "one-hot dispatch has no global-position form)"
             )
 
     @property
@@ -453,6 +464,7 @@ def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig,
                 block_params["ffn"], h, cfg.moe_top_k,
                 cfg.moe_capacity_factor, cfg.cdtype,
                 dispatch=cfg.moe_dispatch, dp_axis=cfg.moe_dp_axis,
+                ffn_remat=cfg.moe_ffn_remat,
             )
         else:
             h = swiglu(block_params["ffn"], h, cfg.cdtype)
@@ -517,6 +529,18 @@ def transformer_lm_with_aux(
         with jax.named_scope("blocks"):
             for i in range(cfg.num_layers):
                 bp = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+                if cfg.num_experts > 0:
+                    # Block XLA from CSE-ing the 12 per-layer fp32→bf16
+                    # weight casts of convert(blocks[i]) into ONE
+                    # whole-stack convert: with E experts the stacked cast
+                    # ([L,E,D,F] bf16) cannot stay live, so XLA remats the
+                    # FULL-stack convert at every layer's use site — traced
+                    # at 47.9 ms/step at the E8k2 peak (1.36 GB of traffic
+                    # × ~23 sites; scripts/trace_moe_step.py). The barrier
+                    # keeps each cast per-layer (~0.14 ms of its own
+                    # slice's traffic). Dense stacks are 8× smaller, stay
+                    # live once-converted, and don't need this.
+                    bp = jax.lax.optimization_barrier(bp)
                 x, aux_i = blk(bp, x)
                 aux = aux + aux_i
 

@@ -197,7 +197,10 @@ def decode_attention_update(q, k_new, v_new, kv_cache, pos,
     newt = pack_kv(k_new, v_new).reshape(rows, 1, w)
     newt = jnp.broadcast_to(newt, (rows, 8, w))
     kvf = kv_cache.reshape(rows, s_all, w)
-    pos1 = jnp.asarray(pos, jnp.int32).reshape(1)
+    # pos is traced, so the pos < attend_len contract cannot be checked at
+    # trace time; clamp so a violation writes/reads the last streamed tile
+    # instead of silently indexing past the block (garbage merge).
+    pos1 = jnp.minimum(jnp.asarray(pos, jnp.int32), attend - 1).reshape(1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -170,11 +170,14 @@ def make_dp_train_step(
     gradient so DP training is step-equivalent to large-batch single-device
     training.
 
-    MoE configs (num_experts > 0) route GLOBALLY: the builder switches the
-    model to the sorted dispatch with ``moe_dp_axis`` set, so capacity is
-    computed over the full global batch and claim positions follow the
-    full-batch fill order (one tiny [W, E] count all-gather per priority —
-    models/moe.py ``route_topk_indexed``). Which tokens drop therefore
+    MoE configs (num_experts > 0) route GLOBALLY: the builder sets
+    ``moe_dp_axis`` so capacity is computed over the full global batch and
+    claim positions follow the full-batch fill order (one tiny [W, E]
+    count all-gather per priority — models/moe.py ``route_topk_indexed``),
+    switching "dense" (which has no global-position form) to "sorted";
+    a configured "sorted"/"sorted_scatter"/"gmm" dispatch is KEPT — gmm is
+    dropless, so its per-shard compute already equals the full batch and
+    only its aux loss takes the global form. Which tokens drop therefore
     matches the single-device full-batch model exactly, and the
     step-equivalence guarantee above covers MoE configs too — drops or not.
     """
@@ -183,8 +186,11 @@ def make_dp_train_step(
     from cs336_systems_tpu.train import lm_loss, make_update_fn
 
     if cfg.num_experts > 0 and cfg.moe_dp_axis is None:
+        dispatch = (
+            "sorted" if cfg.moe_dispatch == "dense" else cfg.moe_dispatch
+        )
         cfg = dataclasses.replace(
-            cfg, moe_dispatch="sorted", moe_dp_axis=axis
+            cfg, moe_dispatch=dispatch, moe_dp_axis=axis
         )
 
     def synced_vag(params, x, y):

@@ -81,12 +81,19 @@ def ring_attention_with_lse(
     FUSED ROPE over the ring: pass the GLOBAL half-width rope cache
     (``rope_cos``/``rope_sin`` [S_global, D/2], replicated) plus this
     shard's global row ``positions`` [S_local], and q/k as the UNROTATED
-    projection outputs. Each hop's kernel rotates in VMEM with q tables
-    gathered at ``positions`` and k tables at ``(positions − t·S_local)
-    mod S_global`` (hop t's block global rows — the shard offset is
-    already inside ``positions``, so no axis_index arithmetic is needed;
-    the mod makes wrapped blocks' tables correct, which non-causal rings
-    rely on and causal rings discard via the lse = −inf merge weight).
+    projection outputs. ``positions`` MUST be 0-based contiguous shard
+    rows — ``axis_index·S_local + arange(S_local)`` over a ring spanning
+    exactly ``axis_size·S_local`` rows (what `parallel/sp.py` builds) —
+    because the wrapped-hop modulus below is ``axis_size·S_local``, not
+    ``positions``-derived. Each hop's kernel rotates in VMEM with q
+    tables gathered at ``positions`` and k tables at ``(positions −
+    t·S_local) mod S_global`` (hop t's block global rows — the shard
+    offset is already inside ``positions``, so no axis_index arithmetic
+    is needed; the mod makes wrapped blocks' tables correct, which
+    non-causal rings rely on and causal rings discard via the lse = −inf
+    merge weight). A non-causal ring with a rope cache longer than the
+    ring span would gather wrong wrapped-hop tables, so that combination
+    is rejected at trace time.
     Gradients are w.r.t. the unrotated q/k, exactly like the
     single-device fused-rope path.
     """
@@ -103,6 +110,13 @@ def ring_attention_with_lse(
     perm = [(i, (i + 1) % w) for i in range(w)]  # send my block to the right
 
     if rope_cos is not None:
+        if not causal and rope_cos.shape[0] != w * s_local:
+            raise ValueError(
+                f"non-causal fused-rope ring needs a rope cache spanning "
+                f"exactly the ring ({w}·{s_local}={w * s_local} rows, got "
+                f"{rope_cos.shape[0]}): wrapped hops gather k tables modulo "
+                f"the ring span, and positions must be 0-based contiguous "
+                f"shard rows (see docstring)")
         q_tab = (jnp.take(rope_cos, positions, 0), jnp.take(rope_sin, positions, 0))
     else:
         q_tab = None

@@ -1,0 +1,129 @@
+"""One MoE training-throughput cell (the results/moe_v5e.txt methodology,
+packaged): build the small-backbone MoE config, run a multi-step in-jit
+train loop fenced ONCE (utils.timing.timed_total — single dispatches are
+dispatch-floor-bound on this runtime), print ms/step, tokens/sec and the
+efficiency columns.
+
+Run ONE cell per process (cross-run buffer retention skews later cells):
+
+  python scripts/bench_moe.py --dispatch sorted --batch 16
+  python scripts/bench_moe.py --dispatch sorted_scatter --batch 16  # r3 A/B
+  python scripts/bench_moe.py --dispatch dense --batch 8 --remat
+"""
+
+import argparse
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.train import init_train_state, make_train_loop
+from cs336_systems_tpu.utils.timing import timed_total
+
+# v5e bf16 peak (chip datasheet), matching bench.py's MFU denominator.
+_PEAK_TFLOPS = 197.0
+
+
+def flops_per_token(cfg, remat: bool, ffn_remat: bool) -> float:
+    """Executed FLOPs per token: bench.model_flops_per_token (the shared
+    MFU-denominator convention, MoE-aware) plus recompute terms so remat
+    rows stay comparable — full-block remat re-runs one forward (+2·N +
+    one causal attention forward); moe_ffn_remat re-runs only the expert
+    gate/up matmuls (2 of the 3, the w2 output is dead code in the
+    recompute)."""
+    from bench import model_flops_per_token
+
+    total = model_flops_per_token(cfg)
+    d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
+    n_ffn = L * max(cfg.moe_top_k, 1) * 3 * d * dff
+    if remat:
+        n = (total - 6 * cfg.context_length * d * L) / 6  # invert 6·N+attn
+        total += 2 * n + 2 * cfg.context_length * d * L
+    elif ffn_remat:
+        total += 2 * (2 / 3) * n_ffn
+    return float(total)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dispatch", default="sorted",
+                   choices=["dense", "sorted", "sorted_scatter", "gmm"])
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--d-ff", type=int, default=None)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--ffn-remat", action="store_true",
+                   help="selective expert-FFN remat (cfg.moe_ffn_remat)")
+    p.add_argument("--cf", type=float, default=1.25,
+                   help="moe_capacity_factor (ignored by dispatch=gmm)")
+    p.add_argument("--ctx", type=int, default=512)
+    p.add_argument("--steps", type=int, default=5, help="in-jit loop length")
+    p.add_argument("--iters", type=int, default=3)
+    args = p.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    overrides = {}
+    if args.d_ff is not None:
+        overrides["d_ff"] = args.d_ff
+    cfg = config_for_size(
+        "small",
+        context_length=args.ctx,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        attn_impl="flash" if on_tpu else "xla",
+        scan_layers=not on_tpu,
+        remat=args.remat,
+        num_experts=args.experts,
+        moe_top_k=args.top_k,
+        moe_dispatch=args.dispatch,
+        moe_ffn_remat=args.ffn_remat,
+        moe_capacity_factor=args.cf,
+        **overrides,
+    )
+    steps = args.steps if on_tpu else 2
+    batch = args.batch if on_tpu else 2
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))
+    xs = jax.random.randint(
+        jax.random.PRNGKey(1), (steps, batch, args.ctx), 0, cfg.vocab_size
+    )
+    ys = jnp.roll(xs, -1, axis=-1)
+
+    def step(params, opt):
+        p2, o2, losses = loop(params, opt, xs, ys)
+        return p2, o2, losses
+
+    res, out = timed_total(
+        step, params, opt, warmup=1, iters=args.iters,
+        carry=lambda out, a: (out[0], out[1]),
+    )
+    from bench import model_flops_per_token
+
+    ms_step = res.mean_ms / steps
+    tokens = batch * args.ctx
+    tok_s = tokens / (ms_step / 1e3)
+    # MFU counts MODEL FLOPs only (recompute is not useful work); the
+    # executed column includes remat recompute so remat rows stay
+    # comparable on achieved hardware FLOP rate.
+    gf_model = model_flops_per_token(cfg) / 1e9
+    gf_exec = flops_per_token(cfg, args.remat, args.ffn_remat) / 1e9
+    mfu = tok_s * gf_model / 1e3 / _PEAK_TFLOPS
+    tag = (f"small+E{args.experts}k{args.top_k}"
+           + (f"/dff{cfg.d_ff}" if args.d_ff else ""))
+    print(
+        f"{tag} ctx{args.ctx} b{batch} cf{args.cf:g} "
+        f"{'remat' if args.remat else 'no-remat'}"
+        f"{'+ffn-remat' if args.ffn_remat else ''} {args.dispatch}: "
+        f"{ms_step:.1f} ms/step  {tok_s:,.0f} tok/s  "
+        f"{gf_model:.3f} GF/tok  "
+        f"exec {tok_s * gf_exec / 1e3:.1f} TFLOP/s  {mfu * 100:.1f}% MFU"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Probe: can a Pallas grouped-expert matmul (megablocks-style `gmm`) beat
+the XLA padded batched expert matmul that the sorted MoE dispatch runs?
+
+Context (round-4 trace, scripts/trace_moe_step.py at the E8k2 b32 peak):
+the expert matmuls run at ~98% of their EXECUTED-FLOP roofline, but they
+execute over E·C capacity slots — cf×(T·k) rows, 25% padding at the
+default capacity factor 1.25. A grouped kernel over tightly packed rows
+(padded per group only to the row tile bm) would cut the padding to
+~E·bm/2 rows (~3-6%), IF Mosaic's grid-step overhead does not eat the
+saving (the dots per grid step are 2-12 us against ~2 us/step overhead —
+the same regime where the flash kernels needed 1024-tiles).
+
+This probe measures the FORWARD only, device-lane timed via an in-jit
+chained loop: y = x @ w[g(row)] with [M=32768(+pad), K=768, N=3072] bf16,
+E=8 — one expert FFN matmul of the b32 cell — against (a) the padded
+[E, C=5120, K] @ [E, K, N] batched dot (what runs today) and (b) the
+tight cf=1.0 [E, 4096, K] batched dot (the XLA lower bound if capacity
+were exact).
+
+Verdict recorded in results/moe_v5e.txt; the kernel is promoted to
+ops/ only if it wins.
+"""
+
+import argparse
+import functools
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cs336_systems_tpu.utils.timing import timed_total
+
+
+def _gmm_fwd_kernel(te_ref, x_ref, w_ref, y_ref):
+    del te_ref  # consumed by the index maps
+    y_ref[:] = jnp.dot(
+        x_ref[:], w_ref[:], preferred_element_type=jnp.float32
+    ).astype(y_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def gmm_fwd(x, w, tile_expert, bm: int = 512, bn: int = 1024,
+            interpret: bool = False):
+    """y[rows of tile i] = x[tile i] @ w[tile_expert[i]].
+
+    x: [M, K] rows grouped by expert, each group padded to a multiple of
+    bm so every row tile belongs to ONE expert; w: [E, K, N];
+    tile_expert: [M//bm] int32 (non-decreasing), a scalar-prefetch
+    operand read by the weight BlockSpec index map.
+    """
+    m, k = x.shape
+    e, k2, n = w.shape
+    assert k2 == k and m % bm == 0 and n % bn == 0
+    wf = w.reshape(e * k, n)
+    return pl.pallas_call(
+        _gmm_fwd_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(m // bm, n // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda i, j, te: (i, 0)),
+                pl.BlockSpec((k, bn), lambda i, j, te: (te[i], j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, te: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=interpret,
+    )(tile_expert, x, wf)
+
+
+def check_correctness():
+    """Interpret-mode oracle check (CPU or TPU)."""
+    key = jax.random.PRNGKey(0)
+    e, k, n, bm = 4, 256, 512, 128
+    counts = [128, 384, 128, 256]  # multiples of bm for the probe
+    m = sum(counts)
+    x = jax.random.normal(key, (m, k), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (e, k, n), jnp.float32)
+    te = np.repeat(np.arange(e), [c // bm for c in counts]).astype(np.int32)
+    y = gmm_fwd(x, w, jnp.asarray(te), bm=bm, bn=n, interpret=True)
+    row = 0
+    for g, c in enumerate(counts):
+        want = x[row:row + c] @ w[g]
+        np.testing.assert_allclose(
+            np.asarray(y[row:row + c]), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+        row += c
+    print("gmm_fwd interpret-mode oracle OK")
+
+
+def bench(bm: int, bn: int, iters: int = 600):
+    # 600 in-jit execs × 2 fenced outer calls: the ~230 ms dispatch+fence
+    # floor (CLAUDE.md) amortizes to ~0.2 ms/call against ~1 ms calls.
+    e, k, n = 8, 768, 3072
+    tk = 32768  # T·k at the b32 cell
+    c_pad = 5120  # cf=1.25 capacity slots per expert
+    c_tight = 4096  # cf=1.0
+    m = tk + e * bm  # tight packing, per-group pad to bm (worst case)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, k), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (e, k, n), jnp.bfloat16)
+    xe_pad = jax.random.normal(jax.random.PRNGKey(2), (e, c_pad, k), jnp.bfloat16)
+    xe_tight = xe_pad[:, :c_tight]
+    te = jnp.asarray(
+        np.repeat(np.arange(e), m // bm // e).astype(np.int32)
+    )
+
+    eps = jnp.bfloat16(1e-2)
+
+    @jax.jit
+    def loop_gmm(x):
+        def body(xc, _):
+            y = gmm_fwd(x=xc, w=w, tile_expert=te, bm=bm, bn=bn)
+            # chain the dependency or the loop body is hoisted (CLAUDE.md)
+            return xc + eps * y[:, :k], None
+        out, _ = jax.lax.scan(body, x, None, length=iters)
+        return out
+
+    @jax.jit
+    def loop_xla(xe):
+        def body(xc, _):
+            y = jax.lax.dot_general(
+                xc, w, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ).astype(xc.dtype)
+            return xc + eps * y[:, :, :k], None
+        out, _ = jax.lax.scan(body, xe, None, length=iters)
+        return out
+
+    flops_tk = 2 * tk * k * n  # useful FLOPs (the claims)
+    for name, fn, arg, rows in [
+        (f"gmm bm{bm} bn{bn} (rows {m})", loop_gmm, x, m),
+        (f"xla padded cf1.25 (rows {e * c_pad})", loop_xla, xe_pad, e * c_pad),
+        (f"xla tight cf1.0 (rows {e * c_tight})", loop_xla, xe_tight,
+         e * c_tight),
+    ]:
+        res, _ = timed_total(fn, arg, warmup=1, iters=2)
+        ms = res.min_ms / iters
+        eff = flops_tk / (ms / 1e3) / 197e12
+        print(f"{name:36s} {ms:8.3f} ms/call  "
+              f"{2 * rows * k * n / (ms / 1e3) / 1e12:6.1f} TF/s executed  "
+              f"{eff * 100:5.1f}% useful-FLOP MFU")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--bm", type=int, default=512)
+    p.add_argument("--bn", type=int, default=1024)
+    p.add_argument("--check", action="store_true")
+    args = p.parse_args()
+    if args.check or jax.default_backend() != "tpu":
+        check_correctness()
+    if jax.default_backend() == "tpu":
+        bench(args.bm, args.bn)

@@ -131,17 +131,192 @@ def test_sorted_dispatch_grads_match_dense():
     assert trees_allclose(g_sort, g_dense, rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("cf", [8.0, 0.75])  # no-drop AND with-drop
-def test_dp_moe_step_matches_full_batch(cf):
+@pytest.mark.parametrize("dispatch", ["dense", "sorted", "sorted_scatter"])
+def test_ffn_remat_grads_match(dispatch):
+    """moe_ffn_remat (jax.checkpoint around the vmapped expert SwiGLU) is a
+    memory trade, not a numerics change: values and grads must match the
+    non-remat path exactly, on every dispatch scheme."""
+    key = jax.random.PRNGKey(11)
+    d, f, e = 16, 32, 4
+    moe = init_moe(key, d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(12), (24, d))
+
+    def run(ffn_remat):
+        def loss(params):
+            out, aux = moe_ffn(x=x, params=params, top_k=2,
+                               capacity_factor=1.25, dispatch=dispatch,
+                               ffn_remat=ffn_remat)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+        out, _ = moe_ffn(x=x, params=moe, top_k=2, capacity_factor=1.25,
+                         dispatch=dispatch, ffn_remat=ffn_remat)
+        return out, jax.grad(loss)(moe)
+
+    out_a, g_a = run(False)
+    out_b, g_b = run(True)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+    assert trees_allclose(g_b, g_a, rtol=1e-6, atol=1e-8)
+
+
+@pytest.mark.parametrize("cf", [8.0, 0.5])  # ample capacity / forced drops
+def test_sorted_scatter_matches_sorted(cf):
+    """The round-3 row-scatter movement (dispatch='sorted_scatter') and the
+    round-4 gather-both-ways movement are the SAME function — identical
+    routing, bit-equal dataflow up to summation order — values and grads."""
+    key = jax.random.PRNGKey(7)
+    d, f, e = 16, 32, 4
+    moe = init_moe(key, d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 24, d))
+
+    def run(dispatch):
+        def loss(params):
+            out, aux = moe_ffn(x=x, params=params, top_k=2,
+                               capacity_factor=cf, dispatch=dispatch)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+        (out, _aux) = moe_ffn(x=x, params=moe, top_k=2, capacity_factor=cf,
+                              dispatch=dispatch)
+        return out, jax.grad(loss)(moe)
+
+    out_s, g_s = run("sorted")
+    out_l, g_l = run("sorted_scatter")
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_l),
+                               rtol=1e-6, atol=1e-7)
+    assert trees_allclose(g_s, g_l, rtol=1e-5, atol=1e-7)
+
+
+def test_gmm_kernel_matches_per_expert_matmul():
+    """grouped_matmul (interpret mode) == per-group x @ w[g], including an
+    EMPTY middle expert, uneven group sizes, and pad rows inside a tile —
+    both values and grads (the dw kernel's accumulate-over-tiles and the
+    visited-mask zeroing of untouched experts)."""
+    from cs336_systems_tpu.ops.grouped_matmul import grouped_matmul, tile_maps
+
+    bm, e, k, n = 8, 4, 16, 32
+    counts = jnp.array([10, 0, 17, 5], jnp.int32)  # expert 1 empty, pads
+    m_pad = int(jnp.sum(counts)) + e * bm
+    te, first, visited, starts = tile_maps(counts, bm, m_pad // bm)
+    x = np.zeros((m_pad, k), np.float32)
+    rows = {}
+    rng = np.random.default_rng(0)
+    for g in range(e):
+        s, c = int(starts[g]), int(counts[g])
+        rows[g] = rng.normal(size=(c, k)).astype(np.float32)
+        x[s:s + c] = rows[g]
+    # native layers.linear [out, in] layout: y = x @ w[g].T
+    w = rng.normal(size=(e, n, k)).astype(np.float32)
+
+    y = grouped_matmul(jnp.asarray(x), jnp.asarray(w), te, first, visited,
+                       bm, True)
+    for g in range(e):
+        s, c = int(starts[g]), int(counts[g])
+        np.testing.assert_allclose(np.asarray(y[s:s + c]), rows[g] @ w[g].T,
+                                   rtol=1e-5, atol=1e-5)
+
+    def loss(x, w):
+        y = grouped_matmul(x, w, te, first, visited, bm, True)
+        # only real rows count, like the combine map does
+        mask = np.zeros((m_pad, 1), np.float32)
+        for g in range(e):
+            mask[int(starts[g]):int(starts[g]) + int(counts[g])] = 1.0
+        return jnp.sum((y * mask) ** 2)
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+
+    def loss_ref(x, w):
+        tot = 0.0
+        for g in range(e):
+            s, c = int(starts[g]), int(counts[g])
+            tot = tot + jnp.sum((x[s:s + c] @ w[g].T) ** 2)
+        return tot
+
+    gx_ref, gw_ref = jax.grad(loss_ref, argnums=(0, 1))(
+        jnp.asarray(x), jnp.asarray(w)
+    )
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gmm_dispatch_matches_sorted_dropless():
+    """dispatch='gmm' (dropless) == dispatch='sorted' at generous capacity
+    (nothing drops there either) — values, aux, and grads."""
+    key = jax.random.PRNGKey(21)
+    d, f, e = 16, 32, 4
+    moe = init_moe(key, d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(22), (2, 24, d))
+
+    def run(dispatch, cf):
+        def loss(params):
+            out, aux = moe_ffn(x=x, params=params, top_k=2,
+                               capacity_factor=cf, dispatch=dispatch)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+
+        out, aux = moe_ffn(x=x, params=moe, top_k=2, capacity_factor=cf,
+                           dispatch=dispatch)
+        return out, aux, jax.grad(loss)(moe)
+
+    out_g, aux_g, g_g = run("gmm", 123.0)
+    out_s, aux_s, g_s = run("sorted", 123.0)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_s),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_g), float(aux_s), rtol=1e-6)
+    assert trees_allclose(g_g, g_s, rtol=1e-4, atol=1e-6)
+
+
+def test_gmm_lm_trains():
+    """A small MoE LM with dispatch='gmm' trains end to end (finite,
+    decreasing loss) — the model-level smoke for the Pallas path
+    (interpret mode on CPU)."""
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="gmm",
+                              moe_ffn_remat=True)
+    params, opt = init_train_state(jax.random.PRNGKey(31), cfg)
+    step = make_train_step(cfg, AdamWHparams(lr=3e-3))
+    x = jax.random.randint(jax.random.PRNGKey(32), (4, 32), 0, 64)
+    y = jnp.roll(x, -1, axis=-1)
+    losses = []
+    for _ in range(6):
+        params, opt, loss = step(params, opt, x, y)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_prefix_count_matches_cumsum():
+    """_prefix_count (blocked tril-matmul prefix sum, the MXU replacement
+    for lax.cumsum's reduce-window lowering) is exact over one-hot counts,
+    including non-multiple-of-block lengths and multi-block inputs."""
+    from cs336_systems_tpu.models.moe import _prefix_count
+
+    for t, e, seed in [(5, 3, 0), (128, 4, 1), (300, 8, 2), (1024, 2, 3)]:
+        idx = jax.random.randint(jax.random.PRNGKey(seed), (t,), 0, e)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        got = _prefix_count(onehot)
+        want = jnp.cumsum(onehot, axis=0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # fp32 input path (the dense router uses fp32 one-hots)
+        got_f = _prefix_count(onehot.astype(jnp.float32))
+        np.testing.assert_array_equal(np.asarray(got_f),
+                                      np.asarray(want.astype(jnp.float32)))
+
+
+@pytest.mark.parametrize("dispatch,cf", [
+    ("sorted", 8.0), ("sorted", 0.75),  # no-drop AND with-drop
+    ("gmm", 1.0),  # dropless: per-shard compute must equal full batch as-is
+])
+def test_dp_moe_step_matches_full_batch(dispatch, cf):
     """DP + MoE == single-device full-batch step, including when capacity
-    drops tokens: the DP builder switches to globally-consistent sorted
-    routing (moe_dp_axis), so drop decisions follow the global fill order."""
+    drops tokens: the DP builder keeps the configured dispatch and sets
+    moe_dp_axis — sorted routes in the global fill order (drop decisions
+    follow the full batch), gmm is dropless so only its aux loss needs the
+    global form."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from cs336_systems_tpu.parallel.dp import make_dp_train_step
 
     cfg = dataclasses.replace(
-        MOE_CFG, moe_capacity_factor=cf, moe_dispatch="sorted"
+        MOE_CFG, moe_capacity_factor=cf, moe_dispatch=dispatch
     )
     mesh = make_mesh({"dp": 4})
     hp = AdamWHparams(lr=1e-3)
