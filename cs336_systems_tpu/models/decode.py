@@ -38,7 +38,8 @@ from cs336_systems_tpu.models.transformer import (
 )
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None):
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None,
+                  num_heads: int | None = None):
     """Zeroed cache pytree: {"kv"} — a per-layer TUPLE of PACKED
     [B, H, S_max, 2*Dh] arrays (compute dtype; K in lanes [0, Dh), V in
     [Dh, 2*Dh) — ops/decode_attention.pack_kv).
@@ -57,7 +58,8 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None
     B=32, ~10× the actual attention+matmul work.
     """
     s = max_len or cfg.context_length
-    shape = (batch, cfg.num_heads, s, 2 * cfg.d_head)
+    h = num_heads if num_heads is not None else cfg.num_heads
+    shape = (batch, h, s, 2 * cfg.d_head)
     return {
         "kv": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
     }
@@ -122,17 +124,33 @@ def _attend_update_xla(q, kv_cache, k_new, v_new, pos,
     return o, kv_cache
 
 
+def _local_heads(attn_params, cfg: TransformerConfig) -> int:
+    """Head count from the q-projection weight's output dim — equals
+    cfg.num_heads single-device, and the PER-SHARD head count when the
+    block runs inside a tensor-parallel shard_map (parallel/serve.py)
+    where the projection weights arrive head-sharded."""
+    w = attn_params["q_proj"]["weight"]
+    return w.shape[-2] // cfg.d_head
+
+
 def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
-                  attend_len: int | None = None, attn_impl: str = "auto"):
+                  attend_len: int | None = None, attn_impl: str = "auto",
+                  reduce_axis: str | None = None):
     """One block on a single-token hidden state; returns (x, kv').
 
     ``kv``: this layer's packed [B, H, S, 2*Dh] cache (init_kv_cache).
     The new token's K/V column is written at ``pos`` and attention runs
     over rows <= pos — in ONE fused Pallas kernel on TPU (in-place tile
     write, ops/decode_attention.decode_attention_update), or a
-    dynamic-update-slice + the shared masked-softmax op elsewhere."""
+    dynamic-update-slice + the shared masked-softmax op elsewhere.
+
+    ``reduce_axis``: mesh axis to psum the row-parallel matmul outputs
+    over — the Megatron f/g pair for head-sharded serving (the attention
+    out-projection and the SwiGLU w2 each produce partial sums when their
+    input dim is sharded). None single-device."""
     b = x.shape[0]
-    h, dh = cfg.num_heads, cfg.d_head
+    dh = cfg.d_head
+    h = _local_heads(bp["attn"], cfg)
     hsplit = lambda t: t.reshape(b, 1, h, dh).transpose(0, 2, 1, 3)
 
     hx = rmsnorm(bp["ln1"], x)
@@ -158,8 +176,14 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
             q, kv, k, v, pos, cfg.attn_window, attend_len
         )
     attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
-    x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-    x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+    attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+    if reduce_axis is not None:
+        attn_out = jax.lax.psum(attn_out, reduce_axis)
+    x = x + attn_out
+    ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+    if reduce_axis is not None:
+        ffn_out = jax.lax.psum(ffn_out, reduce_axis)
+    x = x + ffn_out
     return x, kv
 
 
@@ -167,26 +191,41 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
     """Dense SwiGLU or MoE, matching the training block's dispatch
     (transformer._block). At inference the MoE aux loss is discarded.
 
-    Note on MoE capacity: routing capacity is computed over the tokens in
-    the CALL (moe.moe_capacity) — a decode step routes B tokens while the
-    uncached full forward routes B·S, so capacity-dropped tokens can
-    differ between the paths when any expert overflows; the two agree
-    exactly only when NO tokens drop on either path (sufficiently large
-    capacity_factor for the routing skew — the default 1.25 is not a
-    guarantee), which the oracle test pins on a generous-capacity config."""
+    MoE serving contract (ENFORCED, round 4): decode routing is DROPLESS —
+    the per-expert capacity is pinned to the call's token count T, and
+    since a token's top-k experts are distinct, no expert can ever receive
+    more than T claims, so nothing drops for ANY routing skew. The
+    per-call ``moe_capacity`` formula would make a decode step (T = B
+    tokens) and the full forward (T = B·S) drop DIFFERENT tokens under
+    overflow; serving a learned model should not drop activations at all.
+    The training forward may still drop (its capacity_factor semantics),
+    so decode == full-forward exactly when the full forward is also
+    dropless — tests/test_decode.py pins both the equality and the
+    enforced no-drop behavior at a router skewed enough that the old
+    per-call capacity WOULD have dropped."""
     if cfg.num_experts > 0:
         from cs336_systems_tpu.models.moe import moe_ffn
 
+        t = x.reshape(-1, x.shape[-1]).shape[0]
+        # Serving always routes via an INDEX dispatch: the dense one-hot
+        # form builds [T, E, C] dispatch tensors, and at the dropless
+        # capacity C = T that is O(T²·E) — a compile-killing blow-up at
+        # prefill (T = B·P). The sorted gather path is O(T·k·D) at any
+        # capacity and routing-equivalent (tests pin it); an explicitly
+        # configured "gmm" (dropless by construction) is kept.
+        dispatch = "gmm" if cfg.moe_dispatch == "gmm" else "sorted"
         out, _aux = moe_ffn(
             ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype,
-            dispatch=cfg.moe_dispatch,  # dp_axis never applies at decode
+            dispatch=dispatch,  # dp_axis never applies at decode
+            capacity=t,  # dropless: see docstring
         )
         return out
     return swiglu(ffn_params, x, cfg.cdtype)
 
 
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
-                attend_len: int | None = None, attn_impl: str = "auto"):
+                attend_len: int | None = None, attn_impl: str = "auto",
+                reduce_axis: str | None = None):
     """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
     → (logits [B, vocab] fp32, updated cache).
 
@@ -214,7 +253,7 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
         )
         x, kv = _decode_block(
             bp, x, cache["kv"][l], cos, sin, pos, cfg,
-            attend_len, attn_impl,
+            attend_len, attn_impl, reduce_axis,
         )
         kvs.append(kv)
     x = rmsnorm(params["ln_final"], x)
@@ -222,19 +261,24 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
     return logits.astype(jnp.float32), {"kv": tuple(kvs)}
 
 
-def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None):
+def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None,
+            reduce_axis: str | None = None):
     """Fill the cache with ONE batched forward over the whole prompt (full
     MXU tiles, causal attention), capturing each layer's post-RoPE K/V into
     the cache — identical values to stepwise decoding, since projections
     are position-independent.
 
     prompt_ids: [B, P] (P <= context window). Returns (last-token logits
-    [B, vocab] fp32, cache, next position P)."""
+    [B, vocab] fp32, cache, next position P). ``reduce_axis``: psum axis
+    for head-sharded serving (see _decode_block) — the cache then holds
+    this shard's heads only."""
     b, plen = prompt_ids.shape
-    cache = init_kv_cache(cfg, b, max_len)
+    dh = cfg.d_head
+    blocks = params["blocks"]  # stacked [L, ...] leaves (scan below)
+    h = _local_heads(blocks["attn"], cfg)
+    cache = init_kv_cache(cfg, b, max_len, num_heads=h)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
     positions = jnp.arange(plen)
-    h, dh = cfg.num_heads, cfg.d_head
 
     from cs336_systems_tpu.ops.attention import (
         attention_with_lse,
@@ -259,11 +303,17 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
         k = apply_rope(k, cos, sin, positions)
         attn = attention_with_lse(q, k, v, mask)[0]
         attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * dh)
-        x = x + linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-        x = x + _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+        attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+        if reduce_axis is not None:
+            attn_out = jax.lax.psum(attn_out, reduce_axis)
+        x = x + attn_out
+        ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+        if reduce_axis is not None:
+            ffn_out = jax.lax.psum(ffn_out, reduce_axis)
+        x = x + ffn_out
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    x, (ks, vs) = jax.lax.scan(body, x, blocks)
     x = rmsnorm(params["ln_final"], x)
     logits = linear(params["lm_head"], x, cfg.cdtype)[:, -1].astype(jnp.float32)
 
@@ -286,7 +336,18 @@ def unstack_blocks(params):
     Done ONCE outside the decode scan so the per-layer weight slices are
     loop-invariant: left inside the scan body, XLA declines to hoist them
     (traced ~141 slice DMAs/token at b32 — every block leaf re-sliced per
-    token, ~131 us/token of pure DMA)."""
+    token, ~131 us/token of pure DMA).
+
+    NEGATIVE RESULT (round 4, do not relearn): fusing the q/k/v weights
+    into one [3·H·Dh, d] matmul and the SwiGLU gate/up pair into
+    [2·d_ff, d] — stacked HERE, outside the scan, so the concat is
+    loop-invariant (unlike the training-side qkv_fused negative) — still
+    REGRESSED decode device time 1070 → 1184 us/token (exact, b32,
+    traced). The per-head weight slabs of the separate projections are
+    prefetch-overlapped by XLA (the trace's slice-done lanes run under
+    compute); one big fused weight becomes a synchronous operand read
+    (~HBM-roofline 6.4 us inside the conv op) and the launches it saves
+    were already hidden."""
     blocks = params["blocks"]
     if isinstance(blocks, (tuple, list)):
         return params
@@ -299,7 +360,8 @@ def unstack_blocks(params):
 
 
 def _sample(logits, key, temperature: float, top_k: int | None,
-            top_p: float | None = None, approx_top_k: bool = False):
+            top_p: float | None = None, approx_top_k: bool = False,
+            row_key_offset=None):
     """Reference sampling semantics (model.py:292-303): temperature scale,
     top-k threshold mask, categorical draw — plus nucleus top-p filtering
     (beyond parity; transformer.top_p_filter).
@@ -312,7 +374,15 @@ def _sample(logits, key, temperature: float, top_k: int | None,
     its minimum — the threshold — sits at or BELOW the exact k-th logit:
     the mask then retains the full exact candidate set plus at most a few
     extra tail candidates (a superset; slightly more diversity, never
-    less). Off by default (exact reference semantics)."""
+    less). Off by default (exact reference semantics).
+
+    ``row_key_offset``: when set (traced int32), draw each row from its
+    OWN key ``fold_in(key, offset + row)`` instead of one key over the
+    whole [B, V] block. One shared key makes row i's Gumbel noise depend
+    on the batch SHAPE, so a batch-sharded server could never reproduce
+    the single-device draws; row-keyed streams depend only on each row's
+    global index — what makes sharded serving (parallel/serve.py)
+    bit-identical to the single-device path."""
     logits = logits / temperature
     if top_k is not None:
         k = min(top_k, logits.shape[-1])
@@ -323,6 +393,12 @@ def _sample(logits, key, temperature: float, top_k: int | None,
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         logits = top_p_filter(logits, top_p)
+    if row_key_offset is not None:
+        rows = jnp.arange(logits.shape[0], dtype=jnp.int32) + row_key_offset
+        keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+        return jax.vmap(
+            lambda k_, l: jax.random.categorical(k_, l, axis=-1)
+        )(keys, logits)
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -341,18 +417,20 @@ def _round_up(n: int, m: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p",
-                     "attn_impl", "approx_top_k"),
+                     "attn_impl", "approx_top_k", "reduce_axis"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                    temperature, top_k, top_p=None, attn_impl="auto",
-                   approx_top_k=False):
+                   approx_top_k=False, row_key_offset=None,
+                   reduce_axis=None):
     plen = prompt_ids.shape[1]
     total = plen + max_new_tokens
     # Right-size the cache to this generation (bucket-rounded): decode is
     # cache-bandwidth-bound, so allocating context_length rows and
     # attending over them costs real ms/token when prompt+new << ctx.
     alloc = min(_round_up(total, _ATTEND_BUCKET), cfg.context_length)
-    logits, cache, pos = prefill(params, prompt_ids, cfg, max_len=alloc)
+    logits, cache, pos = prefill(params, prompt_ids, cfg, max_len=alloc,
+                                 reduce_axis=reduce_axis)
     params = unstack_blocks(params)  # loop-invariant per-layer slices
 
     def step(attend_len):
@@ -360,9 +438,10 @@ def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
             cache, pos, logits, key = carry
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p,
-                          approx_top_k).astype(jnp.int32)
+                          approx_top_k, row_key_offset).astype(jnp.int32)
             new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
-                                            attend_len, attn_impl)
+                                            attend_len, attn_impl,
+                                            reduce_axis)
             return (cache, pos + 1, new_logits, key), nxt
 
         return body
@@ -411,12 +490,11 @@ def generate_kv(
     the window); the uncached ``generate`` additionally supports sliding-
     window truncation for longer generations.
 
-    MoE caveat: expert routing capacity is computed per CALL (decode routes
-    B tokens/step, the uncached forward routes B·S at once), so when any
-    expert overflows its capacity the dropped-token sets — and therefore
-    the outputs — can differ between this path and ``generate``. The paths
-    agree exactly only when no tokens drop on either (raise
-    ``cfg.moe_capacity_factor`` if that matters); see ``_ffn``.
+    MoE: decode routing is DROPLESS by contract (capacity pinned to the
+    call's token count — see ``_ffn``), so cached decoding matches the
+    uncached ``generate`` exactly whenever the full forward drops nothing;
+    a training-capacity forward that DOES drop diverges from serving by
+    design (serving never drops activations).
     """
     ids = jnp.asarray(prompt_ids, jnp.int32)
     if ids.ndim != 1:
@@ -455,12 +533,18 @@ def generate_kv_batched(
     top_p: float | None = None,
     attn_impl: str = "auto",
     approx_top_k: bool = False,
+    row_keyed: bool = False,
 ):
     """Batched KV-cached sampling: ``[B, P]`` prompts → one jit dispatch for
     the whole batch's generation. Decoding is matmul-starved at batch 1
     (one [1, d] row against every weight matrix); batching rows is how the
     MXU earns its keep at serving time — same cache/scan machinery, the
     batch rides the existing leading axis.
+
+    ``row_keyed``: draw each row from fold_in(step_key, row) instead of
+    one key over the block (see ``_sample``) — the stream the SHARDED
+    server (parallel/serve.py) reproduces bit-for-bit on any mesh; this
+    flag is the single-device reference for its equivalence tests.
 
     Returns ``[B, max_new_tokens]`` when ``eos_token_id`` is None, else a
     list of per-row arrays truncated at each row's first EOS.
@@ -477,6 +561,7 @@ def generate_kv_batched(
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
         top_p, attn_impl, approx_top_k,
+        row_key_offset=jnp.int32(0) if row_keyed else None,
     )
     if eos_token_id is None:
         return tokens

@@ -41,7 +41,6 @@ import math
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear, swiglu
 from cs336_systems_tpu.ops.grouped_matmul import float0_like as _float0_like
@@ -453,7 +452,7 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
 def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
             compute_dtype=None, dispatch: str = "dense",
             dp_axis: str | None = None, global_tokens: int | None = None,
-            ffn_remat: bool = False):
+            ffn_remat: bool = False, capacity: int | None = None):
     """MoE SwiGLU: [..., S, D] -> ([..., S, D], aux loss scalar).
 
     ``dispatch``: "dense" (one-hot einsums), "sorted" (index dispatch,
@@ -467,7 +466,11 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
     (for "gmm" only the aux loss needs the global form — dropless
     per-shard compute already matches the full batch);
     ``global_tokens`` overrides the token count used for capacity
-    (defaults to T · axis size).
+    (defaults to T · axis size). ``capacity``: explicit per-expert slot
+    count overriding the ``moe_capacity`` formula — e.g. ``capacity=T``
+    makes a call provably dropless (top-k experts are distinct per token,
+    so no expert can receive more than T claims), which is the serving
+    contract (models/decode._ffn).
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
@@ -485,7 +488,7 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
             t_cap = global_tokens or t * jax.lax.axis_size(dp_axis)
         else:
             t_cap = t
-        c = moe_capacity(t_cap, e, top_k, capacity_factor)
+        c = capacity or moe_capacity(t_cap, e, top_k, capacity_factor)
         out, aux = _moe_ffn_sorted(
             params, xt, top_k, c, compute_dtype, dp_axis,
             scatter_rows=dispatch == "sorted_scatter",
@@ -499,7 +502,7 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
         )
     if dispatch != "dense":
         raise ValueError(f"unknown moe dispatch {dispatch!r}")
-    c = moe_capacity(t, e, top_k, capacity_factor)
+    c = capacity or moe_capacity(t, e, top_k, capacity_factor)
 
     router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
     gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E] fp32

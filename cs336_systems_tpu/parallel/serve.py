@@ -1,0 +1,141 @@
+"""Sharded KV-cache serving: batch-data-parallel and head-tensor-parallel
+decoding over a device mesh.
+
+Capability beyond the reference — its only inference path is the
+single-device full-forward-per-token ``generate`` (model.py:255-310).
+This module scales the framework's fast serving path (models/decode.py:
+prefill + in-place packed-KV cache + one-jit generation scan) across
+chips the same way the training stack scales (shard_map over a named
+mesh), keeping the Pallas decode kernel's aliased in-place cache intact —
+each shard's cache leaves live in ITS memory and are updated by ITS
+kernel calls; no cache row ever crosses the interconnect.
+
+Two axes, composable in one 2-D mesh:
+
+- ``dp`` (batch sharding): decode is embarrassingly parallel over rows —
+  params and the PRNG key replicate, prompts/caches/outputs shard, and
+  no collective runs at all. The one subtlety is sampling: a single key
+  over a [B, vocab] block derives row i's Gumbel noise from the whole
+  block shape, so shard-local draws could never match the full-batch
+  draws. Serving therefore uses ROW-KEYED sampling
+  (models/decode._sample row_key_offset): every row draws from
+  fold_in(step_key, global_row), making the tokens bit-identical for any
+  dp layout — pinned by tests/test_serve.py.
+- ``tp`` (head sharding): Megatron-style column/row-parallel weights
+  (parallel/tp.py's specs for the blocks), the KV cache sharded on its
+  head axis, and ONE psum per block pair (attention out-projection and
+  SwiGLU w2 produce partial sums — models/decode reduce_axis). The
+  embedding/lm_head replicate: at serving batch the lm_head matmul is
+  tiny, and a replicated head avoids a per-token vocab all-gather in the
+  sampler.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from cs336_systems_tpu.models.transformer import TransformerConfig
+
+
+def _gen_kwargs(temperature, top_k, top_p, approx_top_k):
+    return dict(temperature=float(temperature), top_k=top_k, top_p=top_p,
+                approx_top_k=approx_top_k)
+
+
+def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None):
+    """PartitionSpec tree for serving params: block weights head-/ff-
+    sharded over ``tp_axis`` (parallel/tp.py's column/row assignment),
+    embedding + lm_head + norms replicated. All-replicated when
+    ``tp_axis`` is None."""
+    if tp_axis is None:
+        return P()
+    from cs336_systems_tpu.parallel.tp import param_specs
+
+    specs = param_specs(cfg, tp_axis)
+    specs["token_embeddings"] = {"weight": P()}
+    specs["lm_head"] = {"weight": P()}  # replicated: no per-token vocab
+    # all-gather in the sampler; the serving-batch head matmul is tiny
+    specs["ln_final"] = {"weight": P()}
+    return specs
+
+
+def make_sharded_generate(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    max_new_tokens: int,
+    dp_axis: str | None = "dp",
+    tp_axis: str | None = None,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    attn_impl: str = "auto",
+    approx_top_k: bool = False,
+):
+    """Build a jitted sharded generation fn:
+    ``(params, prompt_ids [B, P], key) -> tokens [B, max_new_tokens]``.
+
+    ``dp_axis``: mesh axis the batch shards over (B divisible by its
+    size); None = no batch sharding. ``tp_axis``: mesh axis the heads /
+    d_ff shard over (see module docstring); None = no tensor parallelism.
+    Tokens come back fully replicated on tp and batch-sharded on dp.
+
+    Outputs are bit-identical to the single-device row-keyed path
+    (``generate_kv_batched(..., row_keyed=True)``) for ANY mesh layout —
+    the equivalence tests/test_serve.py pins.
+    """
+    if tp_axis is not None:
+        if cfg.num_experts > 0:
+            raise ValueError(
+                "tp serving shards the dense block weights; MoE serving "
+                "shards over dp (expert weights are not in the tp spec)"
+            )
+        from cs336_systems_tpu.parallel.tp import validate_tp
+
+        validate_tp(cfg, mesh, tp_axis)
+
+    from cs336_systems_tpu.models.decode import _generate_scan
+
+    pspecs = serve_param_specs(cfg, tp_axis)
+    batch_spec = P(dp_axis) if dp_axis is not None else P()
+    kw = _gen_kwargs(temperature, top_k, top_p, approx_top_k)
+
+    def local(params, ids, key):
+        if dp_axis is not None:
+            off = jax.lax.axis_index(dp_axis) * ids.shape[0]
+        else:
+            off = jnp.int32(0)
+        return _generate_scan(
+            params, ids, key, cfg, max_new_tokens, kw["temperature"],
+            kw["top_k"], kw["top_p"], attn_impl, kw["approx_top_k"],
+            row_key_offset=off, reduce_axis=tp_axis,
+        )
+
+    fn = jax.jit(shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(pspecs, batch_spec, P()),
+        out_specs=batch_spec,
+        check_vma=False,  # tokens are replicated over tp by construction
+        # (psum'd activations + shared key); the strict checker cannot see
+        # through the sampler to prove it
+    ))  # jitted ONCE here: per-request jax.jit would re-trace the whole
+    # generation scan every call
+
+    def run(params, prompt_ids, key):
+        b = prompt_ids.shape[0]
+        if dp_axis is not None and b % mesh.shape[dp_axis]:
+            raise ValueError(
+                f"batch {b} not divisible by {dp_axis}={mesh.shape[dp_axis]}"
+            )
+        total = prompt_ids.shape[1] + max_new_tokens
+        if total > cfg.context_length:
+            raise ValueError(
+                f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds context_length={cfg.context_length}"
+            )
+        return fn(params, jnp.asarray(prompt_ids, jnp.int32), key)
+
+    return run

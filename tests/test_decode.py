@@ -178,6 +178,62 @@ def test_generate_kv_moe_matches_uncached():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_moe_decode_dropless_under_skew():
+    """The MoE serving contract (models/decode._ffn): decode routing is
+    DROPLESS — capacity pinned to the call's token count — so a router
+    skewed enough to overflow the per-call training capacity still drops
+    nothing at decode, deterministically, and the cached chain equals a
+    dropless full forward token for token."""
+    from cs336_systems_tpu.models.moe import moe_capacity, route_topk_indexed
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    moe_cfg = dataclasses.replace(
+        CFG, num_experts=4, moe_top_k=2, moe_capacity_factor=1.0
+    )
+    moe_params = init_transformer_lm(jax.random.PRNGKey(5), moe_cfg)
+    # Skew the router hard toward expert 0: bias its logit row up by a
+    # large constant so (nearly) every token's top-1 lands on expert 0.
+    w = np.array(moe_params["blocks"]["ffn"]["router"]["weight"])
+    w[:, 0, :] += 8.0
+    moe_params["blocks"]["ffn"]["router"]["weight"] = jnp.asarray(w)
+
+    # The overflow premise must hold: at the OLD per-call capacity a
+    # single decode call (B=1 token... use the prefill call, T=B·P) would
+    # drop. Verify with the actual router on the prompt tokens.
+    prompt = [1, 2, 3, 0, 2, 1]
+    from cs336_systems_tpu.models.layers import embedding, linear, rmsnorm
+
+    x = embedding(moe_params["token_embeddings"], jnp.asarray([prompt]))
+    h = rmsnorm(
+        jax.tree_util.tree_map(lambda a: a[0], moe_params["blocks"])["ln1"], x
+    )
+    t = len(prompt)
+    gates = jax.nn.softmax(
+        linear(
+            jax.tree_util.tree_map(lambda a: a[0], moe_params["blocks"])
+            ["ffn"]["router"], h.reshape(t, -1).astype(jnp.float32),
+            jnp.float32,
+        ),
+        axis=-1,
+    )
+    old_cap = moe_capacity(t, 4, 2, 1.0)
+    _, pos, _, _ = route_topk_indexed(gates, 2, old_cap)
+    assert bool(jnp.any(pos >= old_cap)), "skew failed to overflow old capacity"
+
+    # Dropless contract: cached decode == dropless full-forward generate.
+    kw = dict(max_new_tokens=8, temperature=1e-3, top_k=None)
+    key = jax.random.PRNGKey(7)
+    dropless_cfg = dataclasses.replace(
+        moe_cfg, moe_capacity_factor=float(moe_cfg.num_experts)
+    )  # C = k·T ≥ T: the full forward provably drops nothing either
+    want = generate(moe_params, dropless_cfg, prompt, key=key, **kw)
+    got = generate_kv(moe_params, moe_cfg, prompt, key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # and it is deterministic call to call
+    again = generate_kv(moe_params, moe_cfg, prompt, key=key, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(again))
+
+
 def test_generate_kv_batched_matches_single_row(params):
     """Greedy-ish batched decoding must reproduce the single-sequence path
     row by row (identical prompts, shared key, near-argmax temperature)."""
