@@ -1,0 +1,187 @@
+"""Probe: native int8 MXU dots for the decode-attention kernel.
+
+Round 3 recorded the FIRST int8 negative: an int8 KV cache with in-VMEM
+dequant loses to bf16 (50.4 vs 38.3 us/call at S=256; 203 vs 138 at
+S=1024) — the full-slab dequant elementwise pass costs more than the
+halved DMA saves. Its stated escape hatch: do the score dot NATIVELY in
+int8 (q quantized too, per-row scales folded into the scores after the
+dot) so only the V half needs dequantizing for the weighted-sum dot.
+This probe builds that kernel and measures it.
+
+Kernel variants at the serving shape (rows = B·H, packed W = 2·Dh):
+  bf16  — attend-only bf16 kernel (the baseline math of
+          ops/decode_attention.py without the column update)
+  i8    — int8 K/V slab: scores = dot_general(q_i8, k_i8) -> int32 on the
+          MXU, scaled by qs[row]·ks post-dot; V half dequantized in VMEM
+          (half the round-3 dequant) for the bf16 weighted-sum dot.
+
+Measured on v5e via a chained in-jit loop (dispatch floor amortized).
+Verdict recorded in results/decode_v5e.txt.
+"""
+
+import argparse
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from cs336_systems_tpu.utils.timing import timed_total
+
+
+def _attend_bf16_kernel(q_ref, kv_ref, o_ref, *, scale):
+    g, _, w = q_ref.shape
+    d = w // 2
+    kv = kv_ref[:]  # [G, S, W]
+    k = kv[:, :, :d]
+    v = kv[:, :, d:]
+    s = jax.lax.dot_general(
+        q_ref[:, :, :d], k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [G, 8, S]
+    p = jax.nn.softmax(s, axis=-1)
+    o_ref[:] = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def _attend_i8_kernel(q_ref, qs_ref, kv_ref, ks_ref, o_ref, *, scale):
+    g, _, w = q_ref.shape
+    d = w // 2
+    kv = kv_ref[:]  # [G, S, W] int8
+    k = kv[:, :, :d]
+    v = kv[:, :, d:]
+    # native int8 MXU dot -> int32; per-row scales folded AFTER the dot
+    s32 = jax.lax.dot_general(
+        q_ref[:, :, :d], k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # [G, 8, S]
+    qs = qs_ref[:]  # [G, 8]
+    ks = ks_ref[:]  # [G, S]
+    s = s32.astype(jnp.float32) * (scale * qs[:, :, None]) * ks[:, None, :]
+    p = jax.nn.softmax(s, axis=-1)
+    # only the V half dequantizes (half the round-3 full-slab pass)
+    vdq = v.astype(jnp.bfloat16) * ks[:, :, None].astype(jnp.bfloat16)
+    o_ref[:] = jax.lax.dot_general(
+        p.astype(jnp.bfloat16), vdq, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+def _call(kernel, outs, g, s, w, *operands, interpret):
+    rows = operands[-1].shape[0]
+    specs = []
+    for op in operands:
+        if op.ndim == 3:
+            specs.append(pl.BlockSpec((g, op.shape[1], op.shape[2]),
+                                      lambda r: (r, 0, 0)))
+        else:
+            specs.append(pl.BlockSpec((g, op.shape[1]), lambda r: (r, 0)))
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // g,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((g, 8, w // 2), lambda r: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 8, w // 2), jnp.bfloat16),
+        interpret=interpret,
+    )(*operands)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=384)  # b32 x 12 heads
+    args = p.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+    d = 64
+    w = 2 * d
+    scale = 1.0 / d ** 0.5
+    key = jax.random.PRNGKey(0)
+
+    for s_len in (256, 1024):
+        # group cap keeping the double-buffered bf16 slab under ~8 MB VMEM
+        # (the real kernel's _pick_group discipline)
+        g = max(1, min(48, 8 * 1024 * 1024 // (s_len * w * 2 * 2)))
+        while args.rows % g:
+            g //= 2
+        q = jax.random.normal(key, (args.rows, 8, w), jnp.bfloat16)
+        kv = jax.random.normal(jax.random.PRNGKey(1), (args.rows, s_len, w),
+                               jnp.bfloat16)
+        # symmetric per-row int8 quantization
+        ks = (jnp.max(jnp.abs(kv), axis=(1, 2)) / 127.0).astype(jnp.float32)
+        kv_i8 = jnp.clip(
+            jnp.round(kv.astype(jnp.float32) / ks[:, None, None]), -127, 127
+        ).astype(jnp.int8)
+        ksr = jnp.broadcast_to(ks[:, None], (args.rows, s_len)).astype(jnp.float32)
+        qs = (jnp.max(jnp.abs(q), axis=(1, 2)) / 127.0).astype(jnp.float32)
+        q_i8 = jnp.clip(
+            jnp.round(q.astype(jnp.float32) / qs[:, None, None]), -127, 127
+        ).astype(jnp.int8)
+        qsr = jnp.broadcast_to(qs[:, None], (args.rows, 8)).astype(jnp.float32)
+
+        # MARGINAL per-call timing: the chained outer call carries a fixed
+        # ~120 ms cost on this runtime (operand re-placement + dispatch),
+        # so a single loop length reports amortization, not the kernel.
+        # Timing TWO loop lengths and taking the difference quotient
+        # cancels the constant: (t_long - t_short) / (n_long - n_short).
+        bf = functools.partial(_attend_bf16_kernel, scale=scale)
+        i8 = functools.partial(_attend_i8_kernel, scale=scale)
+
+        # correctness first (vs each other, quantization tolerance)
+        o_bf = _call(bf, None, g, s_len, w, q, kv, interpret=interpret)
+        o_i8 = _call(i8, None, g, s_len, w, q_i8, qsr, kv_i8, ksr,
+                     interpret=interpret)
+        err = float(jnp.max(jnp.abs(o_bf.astype(jnp.float32)
+                                    - o_i8.astype(jnp.float32))))
+        print(f"S={s_len}: max|bf16-i8| = {err:.4f} (int8 quantization noise)")
+        if not on_tpu:
+            continue
+
+        eps = jnp.bfloat16(1e-2)
+        n_short, n_long = 400, 1500
+
+        def marginal(make_run, carry0):
+            times = {}
+            for n in (n_short, n_long):
+                run = make_run(n)
+                res, _ = timed_total(run, carry0, warmup=1, iters=2)
+                times[n] = res.min_ms
+            return (times[n_long] - times[n_short]) / (n_long - n_short) * 1e3
+
+        def bf_run(n):
+            @jax.jit
+            def run(qv):
+                def body(qc, _):
+                    o = _call(bf, None, g, s_len, w, qc, kv,
+                              interpret=False)
+                    return qc + eps * jnp.tile(o, (1, 1, 2)).astype(qc.dtype), None
+                out, _ = jax.lax.scan(body, qv, None, length=n)
+                return out
+            return run
+
+        def i8_run(n):
+            # q_i8 must stay int8, so the chain rides the q scales instead
+            @jax.jit
+            def run(qsr_c):
+                def body(c, _):
+                    o = _call(i8, None, g, s_len, w, q_i8, c, kv_i8,
+                              ksr, interpret=False)
+                    return c + 1e-6 * o[:, :, 0].astype(jnp.float32), None
+                out, _ = jax.lax.scan(body, qsr_c, None, length=n)
+                return out
+            return run
+
+        t_bf = marginal(bf_run, q)
+        t_i8 = marginal(i8_run, qsr)
+        print(f"S={s_len}: bf16 {t_bf:7.1f} us/call   int8-native {t_i8:7.1f} "
+              f"us/call   ({t_bf / t_i8:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
