@@ -128,7 +128,7 @@ def benchmark_variant(
     dt = res.mean_ms / 1e3
     mem_after_step = live_buffer_bytes()
 
-    return {
+    row = {
         "variant": label,
         "world": mesh.devices.size,
         "step_ms": round(res.mean_ms, 2),
@@ -137,6 +137,27 @@ def benchmark_variant(
         "mem_step_mb": round((mem_after_step - mem0) / 2**20, 1),
         "loss": round(float(loss), 4),
     }
+    if variant == "bucketed" and not fsdp and not sharded:
+        # zero1 (sharded) reduces per-leaf via reduce-scatter — bucket
+        # size never applies to it
+        row["bucket_mb"] = bucket_mb
+        row["n_collectives"] = count_bucket_collectives(cfg, bucket_mb)
+    return row
+
+
+def count_bucket_collectives(cfg, bucket_mb: float) -> int:
+    """Gradient collectives per step for the bucketed variant: one fused
+    all-reduce per group, counted by the SAME grouping the step issues
+    (parallel/dp.collective_groups — dtype split included; the
+    hardware-independent observable of the bucket-size sweep, mirroring
+    the reference's table, ddp_bucketed_overlapped_sharded.py:390-404)."""
+    from cs336_systems_tpu.parallel.dp import collective_groups
+
+    params = jax.eval_shape(
+        lambda k: init_transformer_lm(k, cfg), jax.random.PRNGKey(0)
+    )
+    leaves = jax.tree_util.tree_leaves(params)
+    return len(collective_groups(leaves, "bucketed", bucket_mb))
 
 
 def main(argv=None) -> None:
@@ -162,6 +183,10 @@ def main(argv=None) -> None:
     p.add_argument("--heads", type=int, default=SMALL_GPT["num_heads"])
     p.add_argument("--vocab", type=int, default=10_000)
     p.add_argument("--bucket-mb", type=float, default=1000.0)
+    p.add_argument("--bucket-sweep", nargs="+", type=float, default=None,
+                   help="extra bucketed rows at these bucket sizes (MB) — "
+                        "the n_collectives column is the sweep's "
+                        "hardware-independent observable")
     p.add_argument("--latex", type=str, default=None)
     args = p.parse_args(argv)
 
@@ -186,6 +211,13 @@ def main(argv=None) -> None:
             benchmark_variant(
                 cfg, mesh, v, batch_size=args.batch, warmup=args.warmup,
                 steps=args.steps, bucket_mb=args.bucket_mb,
+            )
+        )
+    for mb in args.bucket_sweep or ():
+        rows.append(
+            benchmark_variant(
+                cfg, mesh, "bucketed", batch_size=args.batch,
+                warmup=args.warmup, steps=args.steps, bucket_mb=mb,
             )
         )
     if args.sharded:

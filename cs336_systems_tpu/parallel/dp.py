@@ -62,6 +62,32 @@ def local_value_and_grad(loss_fn: Callable, axis: str = "dp") -> Callable:
     return fn
 
 
+def collective_groups(leaves, variant: str, bucket_size_mb: float,
+                      active: list[int] | None = None) -> list[list[int]]:
+    """Leaf-index groups that become ONE fused collective each for the
+    flat/bucketed variants: leaves group by dtype first (so bf16 gradients
+    are not silently promoted — and shipped — as fp32), then the bucketed
+    variant splits each dtype group by ``assign_buckets``. Shared by
+    ``sync_grads`` (the actual step) and the DDP benchmark's
+    n_collectives column, so the reported count is the issued count."""
+    if active is None:
+        active = list(range(len(leaves)))
+    by_dtype: dict = {}
+    for i in active:
+        by_dtype.setdefault(leaves[i].dtype, []).append(i)
+    groups: list[list[int]] = []
+    for idxs in by_dtype.values():
+        if variant == "flat":
+            groups.append(idxs)
+        else:  # bucketed
+            groups.extend(
+                [idxs[j] for j in bucket]
+                for bucket in assign_buckets([leaves[i] for i in idxs],
+                                             bucket_size_mb)
+            )
+    return groups
+
+
 def assign_buckets(leaves, bucket_size_mb: float) -> list[list[int]]:
     """Greedy reverse-order bucketing by byte size.
 
@@ -123,21 +149,7 @@ def sync_grads(
     if variant == "naive":
         return put_back({i: jax.lax.pmean(leaves[i], axis) for i in active})
 
-    # flat/bucketed concatenate raveled leaves; group by dtype first so bf16
-    # gradients are not silently promoted (and shipped) as fp32.
-    by_dtype: dict = {}
-    for i in active:
-        by_dtype.setdefault(leaves[i].dtype, []).append(i)
-
-    groups: list[list[int]] = []
-    for idxs in by_dtype.values():
-        if variant == "flat":
-            groups.append(idxs)
-        else:  # bucketed
-            groups.extend(
-                [idxs[j] for j in bucket]
-                for bucket in assign_buckets([leaves[i] for i in idxs], bucket_size_mb)
-            )
+    groups = collective_groups(leaves, variant, bucket_size_mb, active)
 
     synced: dict = {}
     for group in groups:

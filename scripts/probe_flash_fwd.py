@@ -1,0 +1,91 @@
+"""Decompose the flash forward's remaining roofline gap at the headline
+shape (rows = B·H = 192, S = 512, Dh = 64, causal bf16: 0.262 ms/layer
+measured round 3 vs 0.13 ms matmul roofline — BASELINE.md attention row).
+
+Ablations, all device-lane timed (trace; host walls are dispatch-bound):
+
+  default        — the shipping config (512-tile clamp -> single-k-tile
+                   fast path, G=4 grouping)
+  qtile256/128   — smaller q tiles with k_tile still covering S (more
+                   grid steps, same single-k-tile math): isolates Mosaic
+                   grid-step overhead vs per-tile compute
+  noncausal      — same shape without the mask: isolates mask cost
+                   (the single-tile path applies the mask inline)
+  d128           — double head dim: MXU work doubles, softmax/VPU work
+                   per score stays — separates MXU-bound from VPU-bound
+                   time (if fwd time scales ~2x, it is MXU/DMA-bound; if
+                   much less, the VPU softmax is the floor)
+  fp32           — fp32 at the same shape (VPU ops are dtype-agnostic on
+                   fp32 lanes; MXU rate halves)
+
+Verdict recorded in BASELINE.md.
+"""
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.ops.flash_attention import flash_attention
+from cs336_systems_tpu.utils.profiling import summarize_trace, trace
+
+
+def device_ms(fn, x, iters=200, logdir="/tmp/flash_fwd_probe"):
+    @jax.jit
+    def loop(q):
+        def body(qc, _):
+            o = fn(qc)
+            return qc + jnp.asarray(1e-2, qc.dtype) * o, None
+        out, _ = jax.lax.scan(body, q, None, length=iters)
+        return out
+
+    jax.block_until_ready(loop(x))  # compile + warm
+    with trace(logdir):
+        jax.block_until_ready(loop(x))
+    rows, total = summarize_trace(logdir)
+    # the kernel is the only custom call in the loop; everything else is
+    # the chain add
+    kern = sum(r["total_ms"] for r in rows
+               if "fusion" not in r["op"] and "add" not in r["op"]
+               and r["total_ms"] > 0.01 * total)
+    return total / iters, kern / iters
+
+
+def main():
+    rows, s, d = 192, 512, 64
+    key = jax.random.PRNGKey(0)
+
+    def mk(dtype=jnp.bfloat16, dd=d, ss=s):
+        q = jax.random.normal(key, (rows, ss, dd), dtype)
+        k = jax.random.normal(jax.random.PRNGKey(1), (rows, ss, dd), dtype)
+        v = jax.random.normal(jax.random.PRNGKey(2), (rows, ss, dd), dtype)
+        return q, k, v
+
+    cases = []
+    q, k, v = mk()
+    cases.append(("default (512-tile fast path)", q,
+                  lambda qc: flash_attention(qc, k, v, causal=True)))
+    cases.append(("qtile256", q,
+                  lambda qc: flash_attention(qc, k, v, causal=True,
+                                             q_tile=256, k_tile=512)))
+    cases.append(("qtile128", q,
+                  lambda qc: flash_attention(qc, k, v, causal=True,
+                                             q_tile=128, k_tile=512)))
+    cases.append(("noncausal", q,
+                  lambda qc: flash_attention(qc, k, v, causal=False)))
+    q2, k2, v2 = mk(dd=128)
+    cases.append(("d128", q2,
+                  lambda qc: flash_attention(qc, k2, v2, causal=True)))
+    qf, kf, vf = mk(dtype=jnp.float32)
+    cases.append(("fp32", qf,
+                  lambda qc: flash_attention(qc, kf, vf, causal=True)))
+
+    for i, (name, x, fn) in enumerate(cases):
+        tot, kern = device_ms(fn, x, logdir=f"/tmp/flash_fwd_probe_{i}")
+        print(f"{name:32s} total {tot:7.3f} ms/call   kernel {kern:7.3f}")
+
+
+if __name__ == "__main__":
+    main()

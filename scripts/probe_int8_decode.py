@@ -74,7 +74,7 @@ def _attend_i8_kernel(q_ref, qs_ref, kv_ref, ks_ref, o_ref, *, scale):
     ).astype(o_ref.dtype)
 
 
-def _call(kernel, outs, g, s, w, *operands, interpret):
+def _call(kernel, g, w, *operands, interpret):
     rows = operands[-1].shape[0]
     specs = []
     for op in operands:
@@ -134,9 +134,8 @@ def main():
         i8 = functools.partial(_attend_i8_kernel, scale=scale)
 
         # correctness first (vs each other, quantization tolerance)
-        o_bf = _call(bf, None, g, s_len, w, q, kv, interpret=interpret)
-        o_i8 = _call(i8, None, g, s_len, w, q_i8, qsr, kv_i8, ksr,
-                     interpret=interpret)
+        o_bf = _call(bf, g, w, q, kv, interpret=interpret)
+        o_i8 = _call(i8, g, w, q_i8, qsr, kv_i8, ksr, interpret=interpret)
         err = float(jnp.max(jnp.abs(o_bf.astype(jnp.float32)
                                     - o_i8.astype(jnp.float32))))
         print(f"S={s_len}: max|bf16-i8| = {err:.4f} (int8 quantization noise)")
@@ -158,8 +157,7 @@ def main():
             @jax.jit
             def run(qv):
                 def body(qc, _):
-                    o = _call(bf, None, g, s_len, w, qc, kv,
-                              interpret=False)
+                    o = _call(bf, g, w, qc, kv, interpret=False)
                     return qc + eps * jnp.tile(o, (1, 1, 2)).astype(qc.dtype), None
                 out, _ = jax.lax.scan(body, qv, None, length=n)
                 return out
@@ -170,8 +168,7 @@ def main():
             @jax.jit
             def run(qsr_c):
                 def body(c, _):
-                    o = _call(i8, None, g, s_len, w, q_i8, c, kv_i8,
-                              ksr, interpret=False)
+                    o = _call(i8, g, w, q_i8, c, kv_i8, ksr, interpret=False)
                     return c + 1e-6 * o[:, :, 0].astype(jnp.float32), None
                 out, _ = jax.lax.scan(body, qsr_c, None, length=n)
                 return out
