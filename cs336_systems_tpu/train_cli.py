@@ -377,6 +377,18 @@ def main(argv=None) -> None:
     p.add_argument("--microbatches", type=int, default=None,
                    help="GPipe microbatches (--parallel pp; default = "
                         "pipeline width)")
+    p.add_argument("--moe-dispatch", default=None,
+                   choices=["dense", "sorted", "sorted_scatter", "gmm"],
+                   help="MoE dispatch scheme (default: the config's; "
+                        "'sorted' is the single-chip throughput peak, "
+                        "'gmm' the dropless Pallas grouped matmul — "
+                        "results/moe_v5e.txt)")
+    p.add_argument("--moe-ffn-remat", action="store_true",
+                   help="recompute the expert hidden pair in the backward "
+                        "(fits batch >= 24 on one chip at E8k2)")
+    p.add_argument("--moe-cf", type=float, default=None,
+                   help="MoE capacity factor (default 1.25; 1.0 is the "
+                        "throughput peak, more drops under skew)")
     p.add_argument("--experts", type=int, default=0,
                    help="MoE experts per block (0 = dense; required >0 for "
                         "--parallel ep)")
@@ -421,7 +433,14 @@ def main(argv=None) -> None:
         if v is not None
     }
     if args.experts:
-        overrides.update(num_experts=args.experts, moe_top_k=args.moe_top_k)
+        overrides.update(num_experts=args.experts, moe_top_k=args.moe_top_k,
+                         moe_ffn_remat=args.moe_ffn_remat)
+        if args.moe_dispatch is not None:
+            overrides.update(moe_dispatch=args.moe_dispatch)
+        if args.moe_cf is not None:
+            overrides.update(moe_capacity_factor=args.moe_cf)
+    elif args.moe_dispatch or args.moe_ffn_remat or args.moe_cf is not None:
+        raise SystemExit("--moe-* flags require --experts N")
     cfg = config_for_size(
         args.size,
         context_length=args.ctx,

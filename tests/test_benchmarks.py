@@ -149,14 +149,22 @@ def test_ddp_benchmark_cli_smoke(capsys):
     from cs336_systems_tpu.benchmarks.ddp import main
 
     main([
-        "--variants", "naive", "--sharded", "--fsdp", "--batch", "8",
-        "--ctx", "32",
+        "--variants", "naive", "bucketed", "--sharded", "--fsdp",
+        "--batch", "8", "--ctx", "32",
         "--steps", "1", "--warmup", "1", "--layers", "2", "--dp", "4",
         "--d-model", "64", "--d-ff", "128", "--heads", "4", "--vocab", "128",
+        "--bucket-sweep", "0.05",
     ])
     out = capsys.readouterr().out
-    for token in ("naive", "nosync", "zero1", "fsdp", "step_ms", "comm_pct"):
+    for token in ("naive", "bucketed", "nosync", "zero1", "fsdp", "step_ms",
+                  "comm_pct", "n_collectives"):
         assert token in out, f"missing {token!r} in DDP benchmark output"
+    # the sweep row's collective count reflects the forced tiny bucket
+    # (many buckets), not the single-bucket default
+    import re
+
+    counts = [int(float(c)) for c in re.findall(r"(\d+\.0)\s*$", out, re.M)]
+    assert any(c > 1 for c in counts), out
 
 
 def test_named_scopes_in_hlo():

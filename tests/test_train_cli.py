@@ -78,6 +78,31 @@ def test_cli_window_flag_trains(capsys, monkeypatch):
     assert 0 < win < 10
 
 
+def test_cli_moe_dispatch_flags(capsys, monkeypatch):
+    """--moe-dispatch/--moe-ffn-remat/--moe-cf plumb through to the config
+    (asserted on the constructed cfg) and the run trains; the flags are
+    rejected without --experts."""
+    import cs336_systems_tpu.train_cli as cli
+
+    seen = {}
+    real = cli.config_for_size
+
+    def spy(size, **kw):
+        cfg = real(size, **kw)
+        seen.update(dispatch=cfg.moe_dispatch, remat=cfg.moe_ffn_remat,
+                    cf=cfg.moe_capacity_factor)
+        return cfg
+
+    monkeypatch.setattr(cli, "config_for_size", spy)
+    main(TINY + ["--steps", "2", "--experts", "4", "--moe-dispatch", "gmm",
+                 "--moe-ffn-remat", "--moe-cf", "1.0"])
+    out = capsys.readouterr().out
+    assert seen == {"dispatch": "gmm", "remat": True, "cf": 1.0}
+    assert any(l.startswith("step") for l in out.splitlines())
+    with pytest.raises(SystemExit, match="--moe-"):
+        main(TINY + ["--steps", "1", "--moe-dispatch", "gmm"])
+
+
 def test_cli_ep_mode_trains(capsys):
     """--parallel ep trains an MoE model (different loss surface than the
     dense modes — aux load-balance term — so: finite and decreasing)."""
