@@ -90,6 +90,23 @@ def test_sharded_generate_moe_dp():
     np.testing.assert_array_equal(got, want)
 
 
+def test_sharded_generate_windowed():
+    """Sliding-window attention (cfg.attn_window) rides through the
+    sharded generation unchanged — windowed prefill mask + windowed decode
+    reads per shard, bit-equal to the single-device windowed path."""
+    cfg = dataclasses.replace(CFG, attn_window=8)
+    params, prompts, key = _setup(cfg)
+    want = np.asarray(generate_kv_batched(
+        params, cfg, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True,
+    ))
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    gen = make_sharded_generate(cfg, mesh, max_new_tokens=10, dp_axis="dp",
+                                tp_axis="tp", temperature=0.9, top_k=8)
+    got = np.asarray(gen(params, prompts, key))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_serve_validation():
     mesh = make_mesh({"dp": 4})
     gen = make_sharded_generate(CFG, mesh, max_new_tokens=8)
