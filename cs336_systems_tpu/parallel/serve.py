@@ -28,6 +28,11 @@ Two axes, composable in one 2-D mesh:
   embedding/lm_head replicate: at serving batch the lm_head matmul is
   tiny, and a replicated head avoids a per-token vocab all-gather in the
   sampler.
+
+Known limitation (inherited from models/decode): every prompt in a batch
+shares one length — ragged batches need per-row rope positions, per-row
+prefill masks, and a per-row attend-start in the packed-KV kernel; pad
+or bucket prompts by length at the caller until that lands.
 """
 
 from __future__ import annotations
