@@ -210,9 +210,14 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
         # Serving always routes via an INDEX dispatch: the dense one-hot
         # form builds [T, E, C] dispatch tensors, and at the dropless
         # capacity C = T that is O(T²·E) — a compile-killing blow-up at
-        # prefill (T = B·P). The sorted gather path is O(T·k·D) at any
-        # capacity and routing-equivalent (tests pin it); an explicitly
-        # configured "gmm" (dropless by construction) is kept.
+        # prefill (T = B·P). The sorted gather path avoids the quadratic
+        # one-hot but at C = T still materializes [E·T, D] dispatch rows
+        # and [E, T, d_ff] expert hiddens — O(E·T·D) activation memory,
+        # E/k× more than the routed work needs (binds MoE *prefill* well
+        # before compute at large B·P). "gmm" packs rows tightly
+        # (O(T·k·D), dropless by construction) and is the right dispatch
+        # when prefill activation memory binds; it stays opt-in via
+        # cfg.moe_dispatch pending prefill-shape validation on chip.
         dispatch = "gmm" if cfg.moe_dispatch == "gmm" else "sorted"
         out, _aux = moe_ffn(
             ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype,

@@ -55,18 +55,25 @@ _FULL_BYTES = 5 * 1024 * 1024
 
 
 def _pick_tile(full: int, other: int, itemsize: int) -> int:
-    """Largest divisor tile of ``full`` (dim being tiled) such that the
-    (tile × other) weight block fits the VMEM budget. Raises rather than
-    returning a non-divisor — the grid would silently skip the tail."""
-    bt = full
-    while bt > 128 and (bt * other * itemsize > _FULL_BYTES or full % bt):
-        bt //= 2
-    if full % bt or bt * other * itemsize > 2 * _FULL_BYTES:
-        raise ValueError(
-            f"cannot tile dim {full} (x {other}, itemsize {itemsize}) into "
-            f"dividing MXU blocks under the VMEM budget; pad the model dim "
-            f"to a power-of-two multiple of 128")
-    return bt
+    """Largest divisor tile of ``full`` (dim being tiled) that is a
+    multiple of the 128-lane Mosaic tiling and whose (tile × other) weight
+    block fits the VMEM budget. Raises rather than returning a non-divisor
+    (the grid would silently skip the tail) or a non-128-multiple (Mosaic
+    pads or rejects it — e.g. the naive halving of 10240 lands on 320)."""
+    mults = [t for t in range(128, full + 1, 128) if full % t == 0]
+    fits = [t for t in mults if t * other * itemsize <= _FULL_BYTES]
+    if fits:
+        return max(fits)
+    # Historical 2× slack when nothing fits the soft budget: the smallest
+    # 128-multiple tile (e.g. 128 × a huge `other` dim), else the untiled
+    # whole block (sub-128 interpret-mode test shapes, odd-but-small dims).
+    for t in (mults[:1] + [full]):
+        if t * other * itemsize <= 2 * _FULL_BYTES:
+            return t
+    raise ValueError(
+        f"cannot tile dim {full} (x {other}, itemsize {itemsize}) into "
+        f"dividing 128-multiple MXU blocks under the VMEM budget; pad the "
+        f"model dim to a power-of-two multiple of 128")
 
 
 def _gmm_fwd_kernel(te_ref, x_ref, w_ref, y_ref):

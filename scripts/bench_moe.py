@@ -24,9 +24,11 @@ from cs336_systems_tpu.models.transformer import config_for_size
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 from cs336_systems_tpu.train import init_train_state, make_train_loop
 from cs336_systems_tpu.utils.timing import timed_total
+from bench import V5E_BF16_PEAK_FLOPS, model_flops_per_token
 
-# v5e bf16 peak (chip datasheet), matching bench.py's MFU denominator.
-_PEAK_TFLOPS = 197.0
+# bench.py's MFU denominator (v5e bf16 chip peak) — shared, not redeclared,
+# so the two MFU columns cannot drift.
+_PEAK_TFLOPS = V5E_BF16_PEAK_FLOPS / 1e12
 
 
 def flops_per_token(cfg, remat: bool, ffn_remat: bool) -> float:
@@ -36,8 +38,6 @@ def flops_per_token(cfg, remat: bool, ffn_remat: bool) -> float:
     one causal attention forward); moe_ffn_remat re-runs only the expert
     gate/up matmuls (2 of the 3, the w2 output is dead code in the
     recompute)."""
-    from bench import model_flops_per_token
-
     total = model_flops_per_token(cfg)
     d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
     n_ffn = L * max(cfg.moe_top_k, 1) * 3 * d * dff
@@ -102,8 +102,6 @@ def main() -> None:
         step, params, opt, warmup=1, iters=args.iters,
         carry=lambda out, a: (out[0], out[1]),
     )
-    from bench import model_flops_per_token
-
     ms_step = res.mean_ms / steps
     tokens = batch * args.ctx
     tok_s = tokens / (ms_step / 1e3)

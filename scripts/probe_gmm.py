@@ -142,7 +142,9 @@ def bench(bm: int, bn: int, iters: int = 600):
     ]:
         res, _ = timed_total(fn, arg, warmup=1, iters=2)
         ms = res.min_ms / iters
-        eff = flops_tk / (ms / 1e3) / 197e12
+        from bench import V5E_BF16_PEAK_FLOPS
+
+        eff = flops_tk / (ms / 1e3) / V5E_BF16_PEAK_FLOPS
         print(f"{name:36s} {ms:8.3f} ms/call  "
               f"{2 * rows * k * n / (ms / 1e3) / 1e12:6.1f} TF/s executed  "
               f"{eff * 100:5.1f}% useful-FLOP MFU")

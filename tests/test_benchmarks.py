@@ -160,10 +160,24 @@ def test_ddp_benchmark_cli_smoke(capsys):
                   "comm_pct", "n_collectives"):
         assert token in out, f"missing {token!r} in DDP benchmark output"
     # the sweep row's collective count reflects the forced tiny bucket
-    # (many buckets), not the single-bucket default
-    import re
-
-    counts = [int(float(c)) for c in re.findall(r"(\d+\.0)\s*$", out, re.M)]
+    # (many buckets), not the single-bucket default — parse the
+    # n_collectives column by header position (a trailing-float regex
+    # could be satisfied by any other .0-valued column)
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    header = next(ln for ln in lines if "n_collectives" in ln)
+    cols = header.split()
+    ci = cols.index("n_collectives")
+    counts = []
+    for ln in lines[lines.index(header) + 1:]:
+        toks = ln.split()
+        if len(toks) != len(cols):
+            continue
+        try:
+            v = float(toks[ci])
+        except ValueError:
+            continue
+        if v == v:  # drop NaN cells (rows where bucketing doesn't apply)
+            counts.append(int(v))
     assert any(c > 1 for c in counts), out
 
 
