@@ -90,6 +90,7 @@ def benchmark_decode(
     reps: int = 3,
     experts: int = 0,
     moe_top_k: int = 2,
+    ragged: bool = False,
 ) -> list[dict]:
     from cs336_systems_tpu.models.decode import (
         generate_kv,
@@ -163,6 +164,22 @@ def benchmark_decode(
     # rows carry that constant, CLAUDE.md).
     for b in batch_sizes:
         prompts = jnp.tile(jnp.asarray([prompt], jnp.int32), (b, 1))
+
+        def batched_row(path: str, dt_b: float, b=b):
+            roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
+            dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
+            return {
+                "path": path,
+                "prompt": prompt_len,
+                "new_tokens": new_tokens,
+                "total_ms": round(dt_b * 1e3, 1),
+                "tokens_per_s": round(b * new_tokens / dt_b, 1),
+                "ms_per_token": round(dt_b * 1e3 / (b * new_tokens), 3),
+                "roofline_ms": round(roof_ms, 1),
+                "device_est_ms": round(dev_ms, 1),
+                "roofline_frac": round(roof_ms / dev_ms, 2) if dev_ms > 0 else None,
+            }
+
         # exact sampling (reference semantics: full-sort top-k) and the
         # approx_top_k variant (TPU partial-reduction threshold — the
         # exact sort costs a flat ~293 us/token at the 10k vocab, traced)
@@ -174,21 +191,31 @@ def benchmark_decode(
                 ),
                 reps,
             )
-            roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
-            dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
-            rows.append(
-                {
-                    "path": f"kv_cache_b{b}{tag}{moe_tag}",
-                    "prompt": prompt_len,
-                    "new_tokens": new_tokens,
-                    "total_ms": round(dt_b * 1e3, 1),
-                    "tokens_per_s": round(b * new_tokens / dt_b, 1),
-                    "ms_per_token": round(dt_b * 1e3 / (b * new_tokens), 3),
-                    "roofline_ms": round(roof_ms, 1),
-                    "device_est_ms": round(dev_ms, 1),
-                    "roofline_frac": round(roof_ms / dev_ms, 2) if dev_ms > 0 else None,
-                }
+            rows.append(batched_row(f"kv_cache_b{b}{tag}{moe_tag}", dt_b))
+        if ragged and b >= 2:  # b=1 has no spread — the row would be
+            # uniform full-length mislabeled as ragged
+            # RAGGED row: per-row prompt lengths spread 4x (P/4 .. P,
+            # evenly), same padded buffer and the same block-keyed
+            # sampling as the uniform row, so the delta isolates the
+            # per-row position/mask/write machinery (plus the
+            # head-divisor kernel group vs the big uniform group). The
+            # attended prefix is batch-global (bucketed off the longest
+            # row); per-row prefix savings would need paged caches.
+            import numpy as _np
+
+            lens = _np.linspace(prompt_len / 4, prompt_len, b).round().astype(int)
+            lens[-1] = prompt_len
+            # pass the HOST array: prompt_lens is range-validated on the
+            # host, so a per-call device jnp array would cost one
+            # device_get round-trip (~the dispatch floor) every call
+            dt_r, _ = _time_best(
+                lambda: generate_kv_batched(
+                    params, cfg, prompts, new_tokens, key,
+                    temperature=0.8, top_k=50, prompt_lens=lens,
+                ),
+                reps,
             )
+            rows.append(batched_row(f"kv_cache_b{b}_ragged4x{moe_tag}", dt_r))
 
     if uncached:
         # reference semantics: full forward per token (model.py:283-308)
@@ -228,6 +255,9 @@ def main(argv=None) -> None:
                    help="serve a Mixture-of-Experts backbone (E experts, "
                         "top-k routed per token — models/moe.py)")
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--ragged", action="store_true",
+                   help="add a ragged-prompt row per batch (per-row "
+                        "lengths spread 4x, same padded buffer)")
     args = p.parse_args(argv)
 
     rows = []
@@ -237,6 +267,7 @@ def main(argv=None) -> None:
             batch_sizes=tuple(args.batches),
             uncached=args.uncached and j == 0,  # the slow baseline once
             reps=args.reps, experts=args.experts, moe_top_k=args.moe_top_k,
+            ragged=args.ragged,
         )
     df = results_table(rows, args.latex)
     print_table(df)

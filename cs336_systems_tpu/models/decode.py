@@ -102,24 +102,44 @@ def _attend_update_xla(q, kv_cache, k_new, v_new, pos,
     dominant per-token traffic at serving batch), so not touching the
     unfilled tail is a bandwidth saving proportional to 1 − fill/S_max.
     The lane-unpack slices here COPY k/v — fine for CPU tests and the
-    long-prefix fallback; the TPU serving path is the fused kernel."""
+    long-prefix fallback; the TPU serving path is the fused kernel.
+
+    ``pos`` may be [B] per-batch-row positions (ragged serving): each row
+    then writes its own column (a masked where — the dynamic-update-slice
+    form needs one shared offset) and masks its own prefix."""
     from cs336_systems_tpu.ops.attention import attention_with_lse
     from cs336_systems_tpu.ops.decode_attention import pack_kv
 
     d = q.shape[-1]
-    kv_cache = jax.lax.dynamic_update_slice(
-        kv_cache, pack_kv(k_new, v_new), (0, 0, pos, 0)
-    )
+    packed = pack_kv(k_new, v_new)  # [B, H, 1, 2*Dh]
+    if pos.ndim == 1:
+        # per-row start indices: vmap the one-column DUS over batch rows —
+        # a masked full-cache where() would turn the O(column) write into
+        # O(S) on exactly the long-prefix fallback path where S is largest
+        kv_cache = jax.vmap(
+            lambda c, p_, col: jax.lax.dynamic_update_slice(c, col, (0, p_, 0))
+        )(kv_cache, pos, packed)
+    else:
+        kv_cache = jax.lax.dynamic_update_slice(
+            kv_cache, packed, (0, 0, pos, 0)
+        )
     kv_read = kv_cache
     if attend_len is not None and attend_len < kv_read.shape[-2]:
         kv_read = kv_read[:, :, :attend_len]
     s = kv_read.shape[-2]
     idx = jnp.arange(s)
-    mask = idx <= pos
-    if window is not None:
-        mask &= pos - idx < window
+    if pos.ndim == 1:
+        mask = idx[None, :] <= pos[:, None]  # [B, S]
+        if window is not None:
+            mask &= pos[:, None] - idx[None, :] < window
+        mask = mask[:, None, None, :]
+    else:
+        mask = idx <= pos
+        if window is not None:
+            mask &= pos - idx < window
+        mask = mask[None, :]
     o = attention_with_lse(
-        q, kv_read[..., :d], kv_read[..., d:], mask[None, :]
+        q, kv_read[..., :d], kv_read[..., d:], mask
     )[0]
     return o, kv_cache
 
@@ -147,7 +167,10 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
     ``reduce_axis``: mesh axis to psum the row-parallel matmul outputs
     over — the Megatron f/g pair for head-sharded serving (the attention
     out-projection and the SwiGLU w2 each produce partial sums when their
-    input dim is sharded). None single-device."""
+    input dim is sharded). None single-device.
+
+    ``pos`` scalar (one shared write position) or [B] (ragged serving:
+    per-row position → per-row rope angle and attend mask)."""
     b = x.shape[0]
     dh = cfg.d_head
     h = _local_heads(bp["attn"], cfg)
@@ -157,7 +180,8 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
     q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
     k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
     v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
-    positions = pos[None]  # [1]
+    # [1] broadcasts over rows; [B,1,1] gives each row its own angle row
+    positions = pos[:, None, None] if pos.ndim == 1 else pos[None]
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
@@ -231,7 +255,8 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
                 attend_len: int | None = None, attn_impl: str = "auto",
                 reduce_axis: str | None = None):
-    """One incremental step: token_ids [B] at position ``pos`` (scalar int32)
+    """One incremental step: token_ids [B] at position ``pos`` (scalar
+    int32, or [B] per-row positions for ragged serving)
     → (logits [B, vocab] fp32, updated cache).
 
     ``attend_len``: static bound on the filled cache length (pos <
@@ -267,7 +292,7 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
 
 
 def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None,
-            reduce_axis: str | None = None):
+            reduce_axis: str | None = None, prompt_lens=None):
     """Fill the cache with ONE batched forward over the whole prompt (full
     MXU tiles, causal attention), capturing each layer's post-RoPE K/V into
     the cache — identical values to stepwise decoding, since projections
@@ -276,7 +301,18 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     prompt_ids: [B, P] (P <= context window). Returns (last-token logits
     [B, vocab] fp32, cache, next position P). ``reduce_axis``: psum axis
     for head-sharded serving (see _decode_block) — the cache then holds
-    this shard's heads only."""
+    this shard's heads only.
+
+    ``prompt_lens``: [B] int32 per-row prompt lengths (ragged serving).
+    Rows are LEFT-ALIGNED: row i's tokens sit at positions [0, len_i) and
+    the tail is padding (any token id). Positions are absolute, so the
+    shared arange rope and the plain causal mask are already per-row
+    correct — a real token p < len_i only ever attends real tokens
+    j <= p. Pad positions run through the forward and deposit junk K/V in
+    rows [len_i, P), but decoding overwrites them one per step and masks
+    j <= pos_i until it does, so they are never attended. The returned
+    logits come from each row's LAST REAL token (len_i − 1) and the next
+    position is the [B] vector ``prompt_lens``."""
     b, plen = prompt_ids.shape
     dh = cfg.d_head
     blocks = params["blocks"]  # stacked [L, ...] leaves (scan below)
@@ -320,7 +356,18 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
 
     x, (ks, vs) = jax.lax.scan(body, x, blocks)
     x = rmsnorm(params["ln_final"], x)
-    logits = linear(params["lm_head"], x, cfg.cdtype)[:, -1].astype(jnp.float32)
+    if prompt_lens is None:
+        logits = linear(params["lm_head"], x[:, -1:], cfg.cdtype)[:, 0]
+        nxt = plen
+    else:
+        # gather each row's last REAL hidden state BEFORE the lm_head so
+        # the vocab matmul is [B, 1, d], not [B, P, V] (take_along_axis on
+        # the dot output would block XLA's slice-into-dot simplification)
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        x_last = jnp.take_along_axis(x, (lens - 1)[:, None, None], axis=1)
+        logits = linear(params["lm_head"], x_last, cfg.cdtype)[:, 0]
+        nxt = lens
+    logits = logits.astype(jnp.float32)
 
     # write each layer's packed [B, H, P, 2*Dh] prompt K/V into its cache
     # prefix (one-time cost at prefill; per-layer leaves — init_kv_cache)
@@ -332,7 +379,7 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
             for l, c in enumerate(cache["kv"])
         ),
     }
-    return logits, cache, plen
+    return logits, cache, nxt
 
 
 def unstack_blocks(params):
@@ -407,6 +454,37 @@ def _sample(logits, key, temperature: float, top_k: int | None,
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def _check_prompt_lens(prompt_lens, ids_shape) -> jax.Array:
+    """Host-side shape AND range validation for per-row prompt lengths:
+    out-of-range rows would not error downstream — a 0 makes the prefill
+    logit gather wrap to the last pad column, a length beyond the padded
+    width decodes from never-written cache rows — both plausible-looking
+    garbage, so they must be rejected at the entry point.
+
+    Callers on a hot path should pass a HOST (numpy/list) array: a fresh
+    device array costs one blocking device_get here per call (~the
+    dispatch floor on remote runtimes); a REUSED device array only pays
+    it once (jax caches the fetched host value on the array)."""
+    import numpy as np
+
+    lens_np = np.asarray(prompt_lens)
+    if not np.issubdtype(lens_np.dtype, np.integer):
+        raise ValueError(
+            f"prompt_lens must be integers, got dtype {lens_np.dtype} "
+            "(silent truncation would shift row boundaries)"
+        )
+    if lens_np.shape != (ids_shape[0],):
+        raise ValueError(
+            f"prompt_lens must be [batch]={ids_shape[0]}, got {lens_np.shape}"
+        )
+    if lens_np.size and (lens_np.min() < 1 or lens_np.max() > ids_shape[1]):
+        raise ValueError(
+            f"prompt_lens entries must be in [1, {ids_shape[1]}] (the padded "
+            f"prompt width), got range [{lens_np.min()}, {lens_np.max()}]"
+        )
+    return jnp.asarray(lens_np, jnp.int32)
+
+
 # The attended cache prefix grows in static buckets of this many rows:
 # within one bucket segment the decode scan attends a fixed-length slice,
 # and successive segments re-specialize the (tiny) step graph at the next
@@ -427,15 +505,18 @@ def _round_up(n: int, m: int) -> int:
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                    temperature, top_k, top_p=None, attn_impl="auto",
                    approx_top_k=False, row_key_offset=None,
-                   reduce_axis=None):
+                   reduce_axis=None, prompt_lens=None):
     plen = prompt_ids.shape[1]
     total = plen + max_new_tokens
     # Right-size the cache to this generation (bucket-rounded): decode is
     # cache-bandwidth-bound, so allocating context_length rows and
     # attending over them costs real ms/token when prompt+new << ctx.
+    # Ragged batches size by the LONGEST row (plen is the padded width);
+    # shorter rows mask the difference away per step.
     alloc = min(_round_up(total, _ATTEND_BUCKET), cfg.context_length)
     logits, cache, pos = prefill(params, prompt_ids, cfg, max_len=alloc,
-                                 reduce_axis=reduce_axis)
+                                 reduce_axis=reduce_axis,
+                                 prompt_lens=prompt_lens)
     params = unstack_blocks(params)  # loop-invariant per-layer slices
 
     def step(attend_len):
@@ -539,6 +620,8 @@ def generate_kv_batched(
     attn_impl: str = "auto",
     approx_top_k: bool = False,
     row_keyed: bool = False,
+    row_key_offset: int = 0,
+    prompt_lens=None,
 ):
     """Batched KV-cached sampling: ``[B, P]`` prompts → one jit dispatch for
     the whole batch's generation. Decoding is matmul-starved at batch 1
@@ -546,10 +629,19 @@ def generate_kv_batched(
     MXU earns its keep at serving time — same cache/scan machinery, the
     batch rides the existing leading axis.
 
-    ``row_keyed``: draw each row from fold_in(step_key, row) instead of
-    one key over the block (see ``_sample``) — the stream the SHARDED
-    server (parallel/serve.py) reproduces bit-for-bit on any mesh; this
-    flag is the single-device reference for its equivalence tests.
+    ``row_keyed``: draw each row from fold_in(step_key, row_key_offset +
+    row) instead of one key over the block (see ``_sample``) — the stream
+    the SHARDED server (parallel/serve.py) reproduces bit-for-bit on any
+    mesh; this flag is the single-device reference for its equivalence
+    tests. ``row_key_offset`` sets the first row's global index, so a
+    single-row call reproduces row i of a larger batch.
+
+    ``prompt_lens``: [B] per-row prompt lengths — RAGGED batches. Rows are
+    left-aligned in the [B, P] buffer (row i's tokens in columns
+    [0, len_i), tail padding ignored); each row decodes from its own
+    position with its own rope angles and attend mask (see ``prefill``),
+    so a short prompt's generation matches its own single-row call
+    token-for-token instead of absorbing the batch max length.
 
     Returns ``[B, max_new_tokens]`` when ``eos_token_id`` is None, else a
     list of per-row arrays truncated at each row's first EOS.
@@ -563,10 +655,18 @@ def generate_kv_batched(
             f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds context_length={cfg.context_length}"
         )
+    if row_key_offset and not row_keyed:
+        raise ValueError(
+            "row_key_offset only applies with row_keyed=True (it sets the "
+            "first row's global index in the row-keyed stream)"
+        )
+    if prompt_lens is not None:
+        prompt_lens = _check_prompt_lens(prompt_lens, ids.shape)
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
         top_p, attn_impl, approx_top_k,
-        row_key_offset=jnp.int32(0) if row_keyed else None,
+        row_key_offset=jnp.int32(row_key_offset) if row_keyed else None,
+        prompt_lens=prompt_lens,
     )
     if eos_token_id is None:
         return tokens

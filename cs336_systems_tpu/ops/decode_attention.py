@@ -70,18 +70,27 @@ def pack_kv(k, v):
 
 
 def _decode_update_kernel(pos_ref, qp_ref, newt_ref, kv_ref, kvtile_ref,
-                          o_ref, *, scale: float, window: int | None):
+                          o_ref, *, scale: float, window: int | None,
+                          heads_per_row: int | None = None):
     """One grid step: G (batch, head) rows against their packed [S, W]
     cache slabs, plus the in-place 8-row tile write-back.
 
-    pos_ref: [1] int32 scalar-prefetch — the write position (cache rows
-    j < pos are attended; row pos comes from ``newt``).
+    pos_ref: int32 scalar-prefetch — the write position (cache rows
+    j < pos are attended; row pos comes from ``newt``). Shape [1] shared
+    by every row, or [B] per-BATCH-row (ragged serving) when
+    ``heads_per_row`` is set — the group then divides the head count so
+    all G rows of one grid step belong to ONE batch row and share its pos
+    (which is also what lets the write-back stay a single aliased tile).
     qp: [G, 8, W] (q zero-extended over V lanes, 8 identical rows);
     newt: [G, 8, W] (packed new K/V column, 8 identical rows);
     kv: [G, S_attend, W]; outputs: kvtile [G, 8, W] (the aliased cache's
     tile at pos//8), o [G, W].
     """
-    pos = pos_ref[0]
+    if heads_per_row is None:
+        pos = pos_ref[0]
+    else:
+        g0 = qp_ref.shape[0]
+        pos = pos_ref[(pl.program_id(0) * g0) // heads_per_row]
     g, _, w = qp_ref.shape
     s = jax.lax.dot_general(
         qp_ref[:], kv_ref[:], (((2,), (2,)), ((0,), (0,))),
@@ -126,19 +135,32 @@ def _decode_update_kernel(pos_ref, qp_ref, newt_ref, kv_ref, kvtile_ref,
 
 
 def _pick_group(rows: int, s: int, w: int, itemsize: int,
-                d: int) -> int | None:
+                d: int, head_divisor: int | None = None) -> int | None:
     """Largest group keeping the double-buffered packed slab inside VMEM
     (measured flat across G 16..384 at the serving shape — the grid is
     DMA-bound, so G only needs to amortize per-step overhead). None when
     even G=1 exceeds the budget — see ``supported``. fp32 x narrow head
     stays under the Mosaic grouped-dot crash the flash kernels hit at
     fp32 d_head=16 (bisected on chip, same cap as
-    flash_attention._pick_group)."""
+    flash_attention._pick_group).
+
+    ``head_divisor``: per-batch-row write positions (ragged serving)
+    additionally require the group to divide the head count, so a grid
+    step never spans two batch rows with different positions; the
+    candidate list gains non-power-of-two divisors for odd head counts
+    (the scalar-pos list stays exactly the tuned set)."""
     # Any divisor works as a group: every block's trailing two dims equal
     # the array's (the o output is [rows, 1, w] so its (g, 1, w) block is
     # Mosaic-legal at ANY g — a 2-D (g, w) block would force g % 8 == 0).
-    groups = (2, 1) if itemsize == 4 and d < 32 else (96, 48, 32, 16, 8, 4, 2, 1)
+    if itemsize == 4 and d < 32:
+        groups = (2, 1)
+    elif head_divisor is None:
+        groups = (96, 48, 32, 16, 8, 4, 2, 1)
+    else:
+        groups = (96, 48, 32, 24, 16, 12, 8, 6, 4, 3, 2, 1)
     for g in groups:
+        if head_divisor is not None and head_divisor % g:
+            continue
         if rows % g == 0 and g * s * w * itemsize * 2 <= 8 * 1024 * 1024:
             return g
     return None
@@ -160,12 +182,16 @@ def decode_attention_update(q, k_new, v_new, kv_cache, pos,
                             attend_len: int | None = None,
                             interpret: bool | None = None):
     """q, k_new, v_new: [B, H, 1, Dh]; kv_cache: [B, H, S, 2*Dh] packed;
-    pos: scalar int32 (traced) -> (o [B, H, 1, Dh], updated kv_cache).
+    pos: scalar int32 (traced), or [B] int32 per-batch-row positions
+    (ragged serving) -> (o [B, H, 1, Dh], updated kv_cache).
 
     Attends rows j < pos of the cache prefix plus the new column, and
     writes the packed new column at row ``pos`` — in place when XLA can
     donate the cache (it does for jit arguments marked donated and for
-    scan carries, which is how the generation scan calls this).
+    scan carries, which is how the generation scan calls this). With
+    per-row ``pos`` every batch row writes its own column and masks its
+    own prefix; the kernel group then divides the head count so one grid
+    step touches one batch row (see ``_pick_group``).
 
     ``attend_len``: STATIC bound on the filled prefix (caller guarantees
     pos < attend_len, multiple of 8); only that many rows are streamed —
@@ -183,7 +209,10 @@ def decode_attention_update(q, k_new, v_new, kv_cache, pos,
     rows = b * h
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    g = _pick_group(rows, attend, w, kv_cache.dtype.itemsize, d)
+    pos = jnp.asarray(pos, jnp.int32)
+    ragged = pos.ndim == 1
+    g = _pick_group(rows, attend, w, kv_cache.dtype.itemsize, d,
+                    head_divisor=h if ragged else None)
     if g is None:
         raise ValueError(
             f"attended prefix [{attend}, {w}] ({kv_cache.dtype}) exceeds "
@@ -200,7 +229,12 @@ def decode_attention_update(q, k_new, v_new, kv_cache, pos,
     # pos is traced, so the pos < attend_len contract cannot be checked at
     # trace time; clamp so a violation writes/reads the last streamed tile
     # instead of silently indexing past the block (garbage merge).
-    pos1 = jnp.minimum(jnp.asarray(pos, jnp.int32), attend - 1).reshape(1)
+    pos1 = jnp.minimum(pos, attend - 1)
+    pos1 = pos1 if ragged else pos1.reshape(1)
+    if ragged:
+        tile_map = lambda r, p: (r, p[(r * g) // h] // 8, 0)
+    else:
+        tile_map = lambda r, p: (r, p[0] // 8, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -211,13 +245,14 @@ def decode_attention_update(q, k_new, v_new, kv_cache, pos,
             pl.BlockSpec((g, attend, w), lambda r, p: (r, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((g, 8, w), lambda r, p: (r, p[0] // 8, 0)),
+            pl.BlockSpec((g, 8, w), tile_map),
             # 3-D so the block's trailing dims equal the array's at any g
             pl.BlockSpec((g, 1, w), lambda r, p: (r, 0, 0)),
         ],
     )
     kv_out, o = pl.pallas_call(
-        functools.partial(_decode_update_kernel, scale=scale, window=window),
+        functools.partial(_decode_update_kernel, scale=scale, window=window,
+                          heads_per_row=h if ragged else None),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((rows, s_all, w), kv_cache.dtype),

@@ -29,10 +29,12 @@ Two axes, composable in one 2-D mesh:
   tiny, and a replicated head avoids a per-token vocab all-gather in the
   sampler.
 
-Known limitation (inherited from models/decode): every prompt in a batch
-shares one length — ragged batches need per-row rope positions, per-row
-prefill masks, and a per-row attend-start in the packed-KV kernel; pad
-or bucket prompts by length at the caller until that lands.
+Ragged batches are first-class: pass ``prompt_lens`` ([B] per-row prompt
+lengths, rows left-aligned in the padded buffer) and every row decodes
+from its own position — per-row rope angles, per-row attend masks, and a
+per-row write column in the packed-KV kernel (models/decode.prefill /
+ops/decode_attention). The lengths shard with the batch over dp and
+replicate over tp; tokens equal each row's own single-row generation.
 """
 
 from __future__ import annotations
@@ -120,7 +122,7 @@ def make_sharded_generate(
     batch_spec = P(dp_axis) if dp_axis is not None else P()
     temperature = float(temperature)
 
-    def local(params, ids, key):
+    def local(params, ids, key, lens=None):
         if dp_axis is not None:
             off = jax.lax.axis_index(dp_axis) * ids.shape[0]
         else:
@@ -128,21 +130,32 @@ def make_sharded_generate(
         return _generate_scan(
             params, ids, key, cfg, max_new_tokens, temperature,
             top_k, top_p, attn_impl, approx_top_k,
-            row_key_offset=off, reduce_axis=tp_axis,
+            row_key_offset=off, reduce_axis=tp_axis, prompt_lens=lens,
         )
 
-    fn = jax.jit(shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(pspecs, batch_spec, P()),
-        out_specs=batch_spec,
-        check_vma=False,  # tokens are replicated over tp by construction
-        # (psum'd activations + shared key); the strict checker cannot see
-        # through the sampler to prove it
-    ))  # jitted ONCE here: per-request jax.jit would re-trace the whole
-    # generation scan every call
+    # shard_map in_specs are static, so the uniform and ragged entries are
+    # two programs; built lazily and cached (the common case pays for one)
+    fns = {}
 
-    def run(params, prompt_ids, key):
+    def build(ragged: bool):
+        in_specs = (pspecs, batch_spec, P())
+        f = local
+        if ragged:
+            in_specs += (batch_spec,)  # lens shard with their rows
+        else:
+            f = lambda params, ids, key: local(params, ids, key)
+        return jax.jit(shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=batch_spec,
+            check_vma=False,  # tokens are replicated over tp by
+            # construction (psum'd activations + shared key); the strict
+            # checker cannot see through the sampler to prove it
+        ))  # jitted ONCE per entry: per-request jax.jit would re-trace
+        # the whole generation scan every call
+
+    def run(params, prompt_ids, key, prompt_lens=None):
         b = prompt_ids.shape[0]
         if dp_axis is not None and b % mesh.shape[dp_axis]:
             raise ValueError(
@@ -154,6 +167,14 @@ def make_sharded_generate(
                 f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds context_length={cfg.context_length}"
             )
-        return fn(params, jnp.asarray(prompt_ids, jnp.int32), key)
+        ragged = prompt_lens is not None
+        if ragged not in fns:
+            fns[ragged] = build(ragged)
+        args = (params, jnp.asarray(prompt_ids, jnp.int32), key)
+        if ragged:
+            from cs336_systems_tpu.models.decode import _check_prompt_lens
+
+            args += (_check_prompt_lens(prompt_lens, prompt_ids.shape),)
+        return fns[ragged](*args)
 
     return run

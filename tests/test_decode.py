@@ -472,3 +472,162 @@ def test_resolve_impl_requires_aligned_prefix():
     from cs336_systems_tpu.models.decode import _resolve_impl
 
     assert _resolve_impl("auto", 1020, 64, 2) == "xla"
+
+
+def test_ragged_fused_kernel_matches_xla_path():
+    """Per-row write positions (ragged serving) through the fused kernel:
+    each batch row writes its own column and masks its own prefix —
+    values AND updated cache equal the portable per-row where/masked-
+    softmax path, with positions spread across different 8-row tiles,
+    tile boundaries, row 0, and a windowed case."""
+    from cs336_systems_tpu.models.decode import _attend_update_xla
+    from cs336_systems_tpu.ops.decode_attention import (
+        decode_attention_update,
+        pack_kv,
+    )
+
+    key = jax.random.PRNGKey(13)
+    for b, h, s, d, pos, window in [
+        (4, 4, 64, 32, [0, 17, 63, 24], None),
+        (3, 2, 128, 64, [100, 5, 56], 16),
+        (2, 3, 64, 32, [8, 39], None),  # odd head count: group divides h
+    ]:
+        kq, kk, kv, kn1, kn2, key = jax.random.split(key, 6)
+        q = jax.random.normal(kq, (b, h, 1, d))
+        kvc = pack_kv(jax.random.normal(kk, (b, h, s, d)),
+                      jax.random.normal(kv, (b, h, s, d)))
+        k_new = jax.random.normal(kn1, (b, h, 1, d))
+        v_new = jax.random.normal(kn2, (b, h, 1, d))
+        posv = jnp.asarray(pos, jnp.int32)
+        want_o, want_kv = _attend_update_xla(q, kvc, k_new, v_new, posv,
+                                             window)
+        got_o, got_kv = decode_attention_update(
+            q, k_new, v_new, kvc, posv, window=window
+        )
+        msg = f"b={b} h={h} s={s} d={d} pos={pos} window={window}"
+        np.testing.assert_allclose(
+            np.asarray(got_o), np.asarray(want_o), rtol=1e-5, atol=1e-5,
+            err_msg=msg,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_kv), np.asarray(want_kv), err_msg=msg
+        )
+
+
+def test_ragged_generate_matches_per_row_single_calls(params):
+    """THE ragged-serving contract: a batch with an 8x prompt-length
+    spread generates, row for row, exactly what each row's own single-row
+    call generates (row-keyed streams + per-row positions make the batch
+    layout invisible) — through BOTH cached-attention impls. Pad content
+    beyond each row's length must be ignorable."""
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    rng = np.random.default_rng(2)
+    lens = [2, 16, 4, 8]  # 8x spread
+    pmax = max(lens)
+    prompts = np.full((len(lens), pmax), 1, np.int32)
+    rows = [rng.integers(0, CFG.vocab_size, n).astype(np.int32) for n in lens]
+    for i, r in enumerate(rows):
+        prompts[i, : len(r)] = r
+    key = jax.random.PRNGKey(21)
+    kw = dict(temperature=0.9, top_k=8, row_keyed=True)
+
+    for impl in ("xla", "pallas"):
+        got = np.asarray(generate_kv_batched(
+            params, CFG, prompts, 10, key, prompt_lens=np.asarray(lens),
+            attn_impl=impl, **kw,
+        ))
+        for i, r in enumerate(rows):
+            want = np.asarray(generate_kv_batched(
+                params, CFG, r[None], 10, key, row_key_offset=i,
+                attn_impl=impl, **kw,
+            ))[0]
+            np.testing.assert_array_equal(got[i], want,
+                                          err_msg=f"impl={impl} row {i}")
+
+    # junk pad tokens cannot leak into any row's generation
+    prompts2 = prompts.copy()
+    for i, n in enumerate(lens):
+        prompts2[i, n:] = rng.integers(0, CFG.vocab_size, pmax - n)
+    got2 = np.asarray(generate_kv_batched(
+        params, CFG, prompts2, 10, key, prompt_lens=np.asarray(lens), **kw,
+    ))
+    base = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, prompt_lens=np.asarray(lens), **kw,
+    ))
+    np.testing.assert_array_equal(got2, base)
+
+
+def test_ragged_generate_windowed_and_moe():
+    """Ragged decoding composes with sliding-window attention and with
+    MoE (dropless serving routing): each still matches its per-row
+    single-row calls."""
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    rng = np.random.default_rng(3)
+    lens = [3, 12]
+    prompts = np.full((2, 12), 1, np.int32)
+    rows = [rng.integers(0, CFG.vocab_size, n).astype(np.int32) for n in lens]
+    for i, r in enumerate(rows):
+        prompts[i, : len(r)] = r
+    key = jax.random.PRNGKey(22)
+    kw = dict(temperature=0.9, top_k=8, row_keyed=True)
+
+    for cfg in (
+        dataclasses.replace(CFG, attn_window=8),
+        dataclasses.replace(CFG, num_experts=4, moe_top_k=2),
+    ):
+        p = init_transformer_lm(jax.random.PRNGKey(7), cfg)
+        got = np.asarray(generate_kv_batched(
+            p, cfg, prompts, 8, key, prompt_lens=np.asarray(lens), **kw,
+        ))
+        for i, r in enumerate(rows):
+            want = np.asarray(generate_kv_batched(
+                p, cfg, r[None], 8, key, row_key_offset=i, **kw,
+            ))[0]
+            np.testing.assert_array_equal(
+                got[i], want,
+                err_msg=f"{'window' if cfg.attn_window else 'moe'} row {i}")
+
+
+def test_ragged_eos_and_validation(params):
+    """Per-row EOS truncation applies to ragged batches, and a wrong-shape
+    prompt_lens is rejected."""
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    prompts = np.asarray([[1, 2, 3, 1], [4, 5, 1, 1]], np.int32)
+    lens = np.asarray([4, 2])
+    key = jax.random.PRNGKey(23)
+    full = generate_kv_batched(params, CFG, prompts, 10, key,
+                               temperature=0.05, top_k=8, row_keyed=True,
+                               prompt_lens=lens)
+    eos = int(np.asarray(full)[1][3])
+    rows = generate_kv_batched(params, CFG, prompts, 10, key,
+                               temperature=0.05, top_k=8, row_keyed=True,
+                               prompt_lens=lens, eos_token_id=eos)
+    assert isinstance(rows, list) and len(rows) == 2
+    assert all(eos not in np.asarray(r) for r in rows)
+    assert len(rows[1]) <= 3
+
+    with pytest.raises(ValueError, match="prompt_lens"):
+        generate_kv_batched(params, CFG, prompts, 4, key,
+                            prompt_lens=np.asarray([4, 2, 2]))
+
+
+def test_ragged_lens_range_rejected(params):
+    """Out-of-range prompt_lens would produce plausible-looking garbage
+    (wrapped logit gather at 0; never-written cache reads beyond the
+    padded width) — both entry points must reject them."""
+    from cs336_systems_tpu.models.decode import generate_kv_batched
+
+    prompts = np.ones((2, 6), np.int32)
+    key = jax.random.PRNGKey(0)
+    for bad in ([0, 4], [3, 7]):
+        with pytest.raises(ValueError, match="prompt_lens entries"):
+            generate_kv_batched(params, CFG, prompts, 4, key,
+                                prompt_lens=np.asarray(bad))
+    with pytest.raises(ValueError, match="integers"):
+        generate_kv_batched(params, CFG, prompts, 4, key,
+                            prompt_lens=np.asarray([2.7, 3.9]))
+    with pytest.raises(ValueError, match="row_key_offset"):
+        generate_kv_batched(params, CFG, prompts, 4, key, row_key_offset=3)

@@ -118,3 +118,43 @@ def test_serve_validation():
             dataclasses.replace(CFG, num_experts=4), mesh,
             max_new_tokens=8, tp_axis="dp",
         )
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+    ({"tp": 4}, None, "tp"),
+])
+def test_sharded_ragged_matches_single_device(mesh_axes, dp, tp):
+    """Ragged batches (per-row prompt lengths, 4x spread) through the
+    sharded server: lengths shard with their rows over dp, replicate over
+    tp, and the tokens equal the single-device ragged row-keyed path —
+    which itself equals each row's own single-row call
+    (tests/test_decode.py::test_ragged_generate_matches_per_row_single_calls)."""
+    params, prompts, key = _setup(plen=12)
+    rng = np.random.default_rng(4)
+    lens = np.asarray([3, 12, 6, 9, 12, 4, 8, 5])
+    want = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=lens,
+    ))
+
+    mesh = make_mesh(mesh_axes)
+    gen = make_sharded_generate(
+        CFG, mesh, max_new_tokens=10, dp_axis=dp, tp_axis=tp,
+        temperature=0.9, top_k=8,
+    )
+    got = np.asarray(gen(params, prompts, key, prompt_lens=lens))
+    np.testing.assert_array_equal(got, want)
+    # uniform path still works from the same server (separate cached entry)
+    got_u = np.asarray(gen(params, prompts, key))
+    want_u = _reference(params, prompts, key)
+    np.testing.assert_array_equal(got_u, want_u)
+
+
+def test_sharded_ragged_lens_validation():
+    mesh = make_mesh({"dp": 4})
+    gen = make_sharded_generate(CFG, mesh, max_new_tokens=4)
+    params, prompts, key = _setup()
+    with pytest.raises(ValueError, match="prompt_lens"):
+        gen(params, prompts, key, prompt_lens=np.asarray([3, 4]))
