@@ -121,6 +121,26 @@ def _dispatch_rows_bwd(res, g):
 _dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
 
 
+def _invert_map(dest: jax.Array, keep: jax.Array | None, n_rows: int):
+    """Invert a claim→row map into its row→claim form with ONE int32
+    scalar scatter — the only kind of scatter the MoE layers ever issue
+    (row movement is always a gather; see the gather-both-ways note
+    above). Dropped/invalid claims (``keep`` False) are redirected to
+    unique out-of-bounds destinations so ``unique_indices`` holds for
+    the drop-mode scatter. Returns ``(src_clamped [n_rows] int32,
+    valid [n_rows] bool)`` where ``src_clamped[r]`` is the claim filling
+    row ``r`` (0 where no claim does — mask with ``valid``)."""
+    rank = jnp.arange(dest.shape[0], dtype=jnp.int32)
+    dest_sc = dest if keep is None else jnp.where(keep, dest, n_rows + rank)
+    src = (
+        jnp.full((n_rows,), -1, jnp.int32)
+        .at[dest_sc]
+        .set(rank, mode="drop", unique_indices=True)
+    )
+    valid = src >= 0
+    return jnp.where(valid, src, 0), valid
+
+
 @jax.custom_vjp
 def _combine_rows(ye_flat, wk, dest_c, src_c, valid, tok_of_slot):
     """Combined token outputs: [T, D] fp32, out[t] = Σ_j wk[t,j] ·
@@ -221,8 +241,20 @@ def route_topk(gates: jax.Array, top_k: int, capacity: int):
     return dispatch, combine, aux
 
 
+def _shard_index(axes) -> jax.Array:
+    """Raveled shard index over one or several mesh axes (row-major in the
+    given order) — the order ``P((a1, a2))`` shards a batch dim in, so a
+    token shard's raveled index IS its contiguous range's rank."""
+    if isinstance(axes, str):
+        return jax.lax.axis_index(axes)
+    idx = jnp.int32(0)
+    for a in axes:
+        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return idx
+
+
 def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
-                       dp_axis: str | None = None):
+                       dp_axis=None):
     """Index-form routing: the same GShard priority fill as ``route_topk``
     but emitting integer coordinates instead of one-hot tensors.
 
@@ -231,8 +263,10 @@ def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
     fill order — claims with ``pos >= capacity`` are the dropped ones
     (callers scatter with mode="drop", so they simply never land).
 
-    ``dp_axis``: compute positions in the GLOBAL fill order across the
-    data-parallel axis (shards hold contiguous token ranges, so the global
+    ``dp_axis``: mesh axis name — or a TUPLE of names, for batches sharded
+    over several axes at once (the ep all-to-all step shards tokens over
+    (dp, ep)) — to compute positions in the GLOBAL fill order across the
+    token sharding (shards hold contiguous token ranges, so the global
     (priority, shard, token) order IS the full-batch (priority, token)
     order). Costs one [W, E] all-gather of per-expert counts per priority
     — a few KB — and makes drop decisions match the full-batch model
@@ -249,7 +283,9 @@ def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
         local_count = jnp.sum(onehot, axis=0)  # [E]
         if dp_axis is not None:
             counts = jax.lax.all_gather(local_count, dp_axis)  # [W, E]
-            w = jax.lax.axis_index(dp_axis)
+            if counts.ndim > 2:  # tuple axes gather one dim per axis
+                counts = counts.reshape(-1, e)
+            w = _shard_index(dp_axis)
             prev_shards = jnp.sum(
                 jnp.where(jnp.arange(counts.shape[0])[:, None] < w, counts, 0),
                 axis=0,
@@ -342,21 +378,11 @@ def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
         )
         return out.astype(in_dtype), aux
 
-    # Gather-both-ways: materialize claim→slot (dest) AND slot→claim (src);
-    # the src build is the only scatter in the layer and moves int32
-    # scalars, never rows. Dropped claims get unique out-of-bounds dests so
-    # unique_indices holds for the drop-mode scatter.
-    flat_rank = jnp.arange(t * top_k, dtype=jnp.int32)
+    # Gather-both-ways: materialize claim→slot (dest) AND slot→claim (src,
+    # via the one scalar scatter in _invert_map — never a row scatter).
     dest = flat_e * c_buf + local_rank
-    dest_scatter = jnp.where(flat_keep, dest, e * c_buf + flat_rank)
     dest_c = jnp.where(flat_keep, dest, 0)
-    src = (
-        jnp.full((e * c_buf,), -1, jnp.int32)
-        .at[dest_scatter]
-        .set(flat_rank, mode="drop", unique_indices=True)
-    )
-    valid = src >= 0
-    src_c = jnp.where(valid, src, 0)
+    src_c, valid = _invert_map(dest, flat_keep, e * c_buf)
     tok_of_slot = jnp.take(token, src_c)
 
     xe_flat = _dispatch_rows(
@@ -367,6 +393,106 @@ def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
     wk = weight * keep.astype(jnp.float32)  # [T, k]
     out = _combine_rows(
         ye.reshape(e * c_buf, d), wk, dest_c, src_c, valid, tok_of_slot
+    )
+    return out.astype(in_dtype), aux
+
+
+def _moe_ffn_ep_a2a(params, xt, top_k, capacity, compute_dtype,
+                    ep_axis: str, token_axes, ffn_remat: bool):
+    """EXPERT-PARALLEL indexed dispatch: tokens move to their experts'
+    owner devices with explicit ``lax.all_to_all`` over ``ep_axis``
+    (Switch/GShard style), expert compute runs LOCALLY on each shard's
+    E/W experts, and a second all-to-all brings the rows home — replacing
+    the GSPMD-dense einsum path whose O(T·E·C·D) dispatch loses to the
+    indexed form in every measured regime (results/moe_v5e.txt).
+
+    Runs inside a shard_map whose expert leaves are ep-sharded
+    ([E/W, ...] locally) and whose tokens shard over ``token_axes``
+    (e.g. (dp, ep)). Routing uses the GLOBAL fill order over
+    ``token_axes`` (route_topk_indexed), so drop decisions — and
+    therefore every token's output — equal the full-batch single-device
+    "sorted" model exactly; the oracle tests pin it.
+
+    Movement is GATHER-BOTH-WAYS end to end (the round-4 discipline —
+    no row scatter anywhere): claims pack into a [W, S, D] send buffer
+    (S = T_local·k, the worst case of every local claim targeting one
+    shard) via ``_dispatch_rows``; the received rows land in the local
+    [E/W·C, D] expert buffer via a second ``_dispatch_rows`` keyed by the
+    slot ids that ride along as an int32 [W, S] all-to-all; the computed
+    rows retrace both hops (``_dispatch_rows`` + the transposing
+    all-to-all) and ``_combine_rows`` applies the kept-masked weights.
+    The only scatters build int32 slot->row maps (scalar, unique). All
+    four backward directions are gathers plus the all-to-alls' own
+    transposes (an all-to-all transposes to an all-to-all).
+    """
+    t, d = xt.shape
+    e = params["router"]["weight"].shape[0]
+    e_local = params["experts"]["w1"]["weight"].shape[0]
+    if e % e_local:
+        raise ValueError(f"global experts {e} not a multiple of local {e_local}")
+    w = e // e_local
+    in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+
+    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    expert, pos, weight, aux = route_topk_indexed(
+        gates, top_k, capacity, token_axes
+    )
+    keep = pos < capacity  # [T, k], global-fill-order consistent
+
+    s = t * top_k  # per-destination send bound (static worst case)
+    flat_e = expert.reshape(-1)
+    flat_pos = pos.reshape(-1)
+    flat_keep = keep.reshape(-1)
+    dstw = flat_e // e_local  # owner shard of each claim
+    slot_local = (flat_e % e_local) * capacity + flat_pos  # owner-local slot
+
+    # pack claims per destination in token order (kept only)
+    dst_onehot = jax.nn.one_hot(dstw, w, dtype=jnp.int32) * flat_keep[:, None]
+    rank = jnp.sum((_prefix_count(dst_onehot) - dst_onehot) * dst_onehot,
+                   axis=-1)
+    dest_send = dstw * s + rank  # claim -> [W·S] send-buffer row
+    dest_send_c = jnp.where(flat_keep, dest_send, 0)
+    src_send_c, valid_send = _invert_map(dest_send, flat_keep, w * s)
+    token = jnp.repeat(jnp.arange(t), top_k)
+    tok_of_send = jnp.take(token, src_send_c)
+
+    send_x = _dispatch_rows(
+        xt.astype(in_dtype), tok_of_send, valid_send, dest_send_c, flat_keep
+    )  # [W·S, D]
+    send_slot = jnp.where(valid_send, jnp.take(slot_local, src_send_c), -1)
+
+    recv_x = jax.lax.all_to_all(
+        send_x.reshape(w, s, d), ep_axis, 0, 0
+    ).reshape(w * s, d)
+    recv_slot = jax.lax.all_to_all(
+        send_slot.reshape(w, s), ep_axis, 0, 0
+    ).reshape(w * s)
+
+    # received rows -> the local [E/W·C, D] expert buffer (gather both ways;
+    # slots are globally unique: one claim per (expert, global fill pos))
+    valid_recv = recv_slot >= 0
+    slot_c = jnp.where(valid_recv, recv_slot, 0)
+    nrows = e_local * capacity
+    src_buf_c, valid_buf = _invert_map(recv_slot, valid_recv, nrows)
+    xe = _dispatch_rows(recv_x, src_buf_c, valid_buf, slot_c, valid_recv)
+
+    expert_fn = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))
+    if ffn_remat:
+        expert_fn = jax.checkpoint(expert_fn)  # see _moe_ffn_sorted
+    ye = expert_fn(params["experts"], xe.reshape(e_local, capacity, d))
+
+    back = _dispatch_rows(
+        ye.reshape(nrows, d), slot_c, valid_recv, src_buf_c, valid_buf
+    )  # [W·S, D] in the senders' layout
+    back = jax.lax.all_to_all(
+        back.reshape(w, s, d), ep_axis, 0, 0
+    ).reshape(w * s, d)
+
+    wk = weight * keep.astype(jnp.float32)  # kept-mask contract: _combine_rows
+    out = _combine_rows(
+        back, wk, dest_send_c.reshape(t, top_k), src_send_c, valid_send,
+        tok_of_send,
     )
     return out.astype(in_dtype), aux
 
@@ -413,15 +539,8 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
     te, first, visited, starts = tile_maps(counts, bm, m_pad // bm)
 
     token = jnp.repeat(jnp.arange(t), top_k)
-    flat_rank = jnp.arange(t * top_k, dtype=jnp.int32)
     dest = jnp.take(starts, flat_e) + local_rank  # tight packed row
-    src = (
-        jnp.full((m_pad,), -1, jnp.int32)
-        .at[dest]
-        .set(flat_rank, mode="drop", unique_indices=True)
-    )
-    valid = src >= 0
-    src_c = jnp.where(valid, src, 0)
+    src_c, valid = _invert_map(dest, None, m_pad)
     tok_of_slot = jnp.take(token, src_c)
     all_keep = jnp.ones_like(flat_e, dtype=bool)
 
@@ -449,10 +568,20 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
     return out.astype(in_dtype), aux
 
 
+def _axes_size(axes) -> int:
+    if isinstance(axes, str):
+        return jax.lax.axis_size(axes)
+    n = 1
+    for a in axes:
+        n *= jax.lax.axis_size(a)
+    return n
+
+
 def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
             compute_dtype=None, dispatch: str = "dense",
-            dp_axis: str | None = None, global_tokens: int | None = None,
-            ffn_remat: bool = False, capacity: int | None = None):
+            dp_axis=None, global_tokens: int | None = None,
+            ffn_remat: bool = False, capacity: int | None = None,
+            ep_axis: str | None = None):
     """MoE SwiGLU: [..., S, D] -> ([..., S, D], aux loss scalar).
 
     ``dispatch``: "dense" (one-hot einsums), "sorted" (index dispatch,
@@ -462,21 +591,47 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
     matmul, ops/grouped_matmul.py; ``capacity_factor`` is ignored, no
     claim ever drops). The capacity schemes share routing decisions;
     "gmm" shares routing probabilities but never drops. ``dp_axis``
-    (sorted/gmm): full-batch-consistent routing under data parallelism
-    (for "gmm" only the aux loss needs the global form — dropless
-    per-shard compute already matches the full batch);
+    (sorted/gmm): full-batch-consistent routing under data parallelism —
+    a mesh axis name or a tuple of names when the batch shards over
+    several axes (for "gmm" only the aux loss needs the global form —
+    dropless per-shard compute already matches the full batch);
     ``global_tokens`` overrides the token count used for capacity
     (defaults to T · axis size). ``capacity``: explicit per-expert slot
     count overriding the ``moe_capacity`` formula — e.g. ``capacity=T``
     makes a call provably dropless (top-k experts are distinct per token,
     so no expert can receive more than T claims), which is the serving
     contract (models/decode._ffn).
+
+    ``ep_axis``: EXPERT-PARALLEL all-to-all dispatch (requires
+    dispatch="sorted" and a shard_map whose expert leaves are sharded
+    over this axis): tokens travel to their experts' owner shards and
+    back with explicit all-to-alls, expert compute is local — see
+    ``_moe_ffn_ep_a2a``. ``dp_axis`` must then name ALL the token-
+    sharding axes (including ``ep_axis`` if tokens shard over it).
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
     xt = x.reshape(-1, d)  # [T, D]
     t = xt.shape[0]
     e = params["router"]["weight"].shape[0]
+
+    if ep_axis is not None:
+        if dispatch != "sorted":
+            raise ValueError(
+                f"ep_axis (all-to-all expert parallelism) requires "
+                f"dispatch='sorted', got {dispatch!r}"
+            )
+        if dp_axis is None:
+            raise ValueError(
+                "ep_axis requires dp_axis naming the token-sharding axes "
+                "(the global fill order is what the oracle contract pins)"
+            )
+        t_cap = global_tokens or t * _axes_size(dp_axis)
+        c = capacity or moe_capacity(t_cap, e, top_k, capacity_factor)
+        out, aux = _moe_ffn_ep_a2a(
+            params, xt, top_k, c, compute_dtype, ep_axis, dp_axis, ffn_remat
+        )
+        return out.reshape(*lead, d), aux
 
     if dispatch == "gmm":
         out, aux = _moe_ffn_gmm(
@@ -485,7 +640,7 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
         return out.reshape(*lead, d), aux
     if dispatch in ("sorted", "sorted_scatter"):
         if dp_axis is not None:
-            t_cap = global_tokens or t * jax.lax.axis_size(dp_axis)
+            t_cap = global_tokens or t * _axes_size(dp_axis)
         else:
             t_cap = t
         c = capacity or moe_capacity(t_cap, e, top_k, capacity_factor)

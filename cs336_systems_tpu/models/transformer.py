@@ -113,7 +113,14 @@ class TransformerConfig:
     # full-batch-consistent routing under data parallelism (set by the DP
     # builder).
     moe_dispatch: str = "dense"
-    moe_dp_axis: str | None = None
+    # Token-sharding axes for globally-consistent routing — a mesh axis
+    # name, or a TUPLE of names when the batch shards over several axes
+    # (the ep all-to-all step shards tokens over (dp, ep)).
+    moe_dp_axis: str | tuple | None = None
+    # Expert-parallel all-to-all dispatch axis (parallel/ep.py's indexed
+    # step): expert leaves shard over this mesh axis inside a shard_map,
+    # tokens travel by explicit all-to-all — see moe._moe_ffn_ep_a2a.
+    moe_ep_axis: str | None = None
     # Recompute the expert FFN hidden activations in the backward (the
     # [E, C, d_ff] gate/up stash, the MoE layer's largest) — a selective
     # remat far cheaper than cfg.remat's whole-block recompute; it is what
@@ -156,6 +163,17 @@ class TransformerConfig:
                 "dispatch: 'sorted', 'sorted_scatter', or 'gmm' (the dense "
                 "one-hot dispatch has no global-position form)"
             )
+        if self.moe_ep_axis is not None:
+            if self.moe_dispatch != "sorted":
+                raise ValueError(
+                    "moe_ep_axis (all-to-all expert parallelism) requires "
+                    f"moe_dispatch='sorted', got {self.moe_dispatch!r}"
+                )
+            if self.moe_dp_axis is None:
+                raise ValueError(
+                    "moe_ep_axis requires moe_dp_axis naming the token-"
+                    "sharding axes (global fill order is the ep contract)"
+                )
 
     @property
     def d_head(self) -> int:
@@ -464,7 +482,7 @@ def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig,
                 block_params["ffn"], h, cfg.moe_top_k,
                 cfg.moe_capacity_factor, cfg.cdtype,
                 dispatch=cfg.moe_dispatch, dp_axis=cfg.moe_dp_axis,
-                ffn_remat=cfg.moe_ffn_remat,
+                ffn_remat=cfg.moe_ffn_remat, ep_axis=cfg.moe_ep_axis,
             )
         else:
             h = swiglu(block_params["ffn"], h, cfg.cdtype)

@@ -4,15 +4,38 @@ Capability beyond the reference (no MoE anywhere in it), completing the
 mesh-parallelism surface (dp/tp/sp/pp/ep) on the MoE model family
 (models/moe.py).
 
-TPU-first design — like the TP layer, this is GSPMD sharding annotation,
-not hand-written collectives: expert leaves (stacked [L, E, ...] in the
-blocks pytree) are declared ``P(None, "ep", ...)`` on the expert dim, the
-router and all dense weights stay replicated, and ``jit`` propagates the
-shardings through the dispatch einsums — the [E, C, D] expert-batch tensor
-shards over ``ep``, and XLA materializes the dispatch/combine as the
-all-to-all-style collectives an expert-parallel GPU stack writes by hand.
+Two step builders (``make_ep_train_step`` variant=):
+
+- ``"a2a"`` (default, round 5) — the INDEXED dispatch under explicit
+  collectives: tokens shard over (dp × ep), expert leaves (stacked
+  [L, E, ...]) shard over ``ep`` inside a ``shard_map``, and each MoE
+  layer moves routed token rows to their experts' owner shards and back
+  with two ``lax.all_to_all``s (Switch/GShard style), computing experts
+  LOCALLY with the gather-both-ways sorted machinery
+  (models/moe._moe_ffn_ep_a2a). Routing uses the GLOBAL fill order over
+  the token axes, so drops — and every token's output — match the
+  full-batch single-device "sorted" model exactly (oracle-tested).
+  Useful row movement is O(T·k·D) like the single-chip sorted path, and
+  the GSPMD-dense einsums' O(T·E·C·D) dispatch COMPUTE (which loses to
+  "sorted" in every measured single-chip regime, results/moe_v5e.txt)
+  never appears — but note the all-to-all BUFFERS are sized to the
+  static worst case of every local claim targeting one shard
+  ([W, T_local·k, D] per direction), so wire traffic and send/recv
+  memory are O(W·T_local·k·D) with ~(W−1)/W zero padding under balanced
+  routing. Shrinking that bound needs capacity-bounded per-destination
+  sends (a drop-semantics change) or dynamic shapes; recorded here so
+  future multi-chip perf work starts from the honest wire cost.
+- ``"dense"`` (rounds ≤4, kept for A/B) — GSPMD sharding annotation:
+  expert leaves declared ``P(None, "ep", ...)``, jit propagates the
+  shardings through the DENSE one-hot dispatch einsums and XLA
+  materializes the collectives. Simple, but inherits the dense
+  dispatch's O(T·E·C·D) compute.
+
 AdamW moments shard exactly like their parameters, so expert optimizer
-state is also 1/ep per device. Composes with a ``dp`` batch axis.
+state is also 1/ep per device. Gradient clipping under "a2a" reduces the
+global norm correctly across shards: expert-leaf square-norms psum over
+``ep`` (dense leaves are replicated), then the shared clip formula
+applies (ops/nn.clip_gradients with an external norm).
 """
 
 from __future__ import annotations
@@ -82,6 +105,26 @@ def shard_params_ep(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "ep"
     return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
+def _ep_grad_norm(grads, ep_axis: str):
+    """Global L2 gradient norm when expert leaves are ep-sharded: expert
+    square-norms psum over ``ep_axis`` (each shard holds E/W experts),
+    dense leaves count once (replicated — their grads are identical on
+    every shard). Keeping this OUT of the local norm would give each
+    shard a different clip scale and silently diverge the replicated
+    params."""
+    import jax.numpy as jnp
+
+    dense_sq = jnp.zeros((), jnp.float32)
+    exp_sq = jnp.zeros((), jnp.float32)
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if any(getattr(k, "key", None) == "experts" for k in path):
+            exp_sq = exp_sq + sq
+        else:
+            dense_sq = dense_sq + sq
+    return jnp.sqrt(dense_sq + jax.lax.psum(exp_sq, ep_axis))
+
+
 def make_ep_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,
@@ -91,18 +134,72 @@ def make_ep_train_step(
     dp_axis: str | None = "dp",
     ep_axis: str = "ep",
     donate: bool = True,
+    variant: str = "a2a",
 ) -> Callable:
     """Jitted (dp ×) ep MoE train step: expert params/moments sharded over
-    ``ep_axis``, batch sharded over ``dp_axis`` (if the mesh has one).
+    ``ep_axis``, batch sharded over the token axes.
 
-    Like TP, gradient averaging over dp and the expert dispatch collectives
-    are GSPMD-inserted from the sharding annotations — one jit, no forks.
+    ``variant="a2a"`` (default): explicit all-to-all indexed dispatch in
+    a shard_map — tokens shard over (dp × ep), the fast sorted machinery
+    runs locally per expert shard (module docstring). ``variant="dense"``:
+    the GSPMD-annotated dense-dispatch step (rounds ≤4, kept for A/B).
     """
     import dataclasses
 
     from cs336_systems_tpu.train import lm_loss, make_update_fn
 
     validate_ep(cfg, mesh, ep_axis)
+    if variant not in ("a2a", "dense"):
+        raise ValueError(f"unknown ep variant {variant!r} (want 'a2a' or 'dense')")
+
+    if variant == "a2a":
+        from jax import shard_map
+
+        from cs336_systems_tpu.ops.nn import clip_gradients
+
+        if cfg.moe_dispatch not in ("dense", "sorted"):
+            # dense->sorted is routing-equivalent (identical GShard fill,
+            # tested), so rewriting it is safe; gmm promises DROPLESS
+            # numerics the capacity path cannot honor — refuse rather
+            # than silently dropping claims the config says never drop.
+            raise ValueError(
+                f"ep variant='a2a' runs the sorted capacity dispatch; "
+                f"moe_dispatch={cfg.moe_dispatch!r} (dropless) would "
+                "silently change semantics. Use moe_dispatch='sorted' "
+                "(or 'dense'), or variant='dense' for the GSPMD path."
+            )
+        have_dp = bool(dp_axis) and dp_axis in mesh.shape
+        token_axes = (dp_axis, ep_axis) if have_dp else (ep_axis,)
+        ecfg = dataclasses.replace(
+            cfg, moe_dispatch="sorted", moe_dp_axis=token_axes,
+            moe_ep_axis=ep_axis,
+        )
+        batch_spec = P(token_axes)
+        pspecs = param_specs(cfg, ep_axis)
+        ospecs = opt_state_specs(cfg, ep_axis)
+
+        def sharded_loss(p, x, y):
+            return jax.lax.pmean(lm_loss(p, x, y, cfg=ecfg), token_axes)
+
+        def vag(p, x, y):
+            loss, grads = jax.value_and_grad(sharded_loss)(p, x, y)
+            if clip_norm is not None:
+                grads = clip_gradients(
+                    grads, clip_norm, norm=_ep_grad_norm(grads, ep_axis)
+                )
+            return loss, grads
+
+        local_step = make_update_fn(
+            None, hp, clip_norm=None, lr_schedule=lr_schedule,
+            value_and_grad=vag,
+        )
+        step = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+            out_specs=(pspecs, ospecs, P()),
+        )
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
     pspecs = param_specs(cfg, ep_axis)
     ospecs = opt_state_specs(cfg, ep_axis)
     have_dp = dp_axis and dp_axis in mesh.shape

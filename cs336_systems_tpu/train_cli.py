@@ -328,7 +328,9 @@ def _build_mesh_mode(cfg, hp, schedule, parallel, donate, mesh_axes,
         place = lambda p, o: (
             shard_tree(p, mesh, pspecs), shard_tree(o, mesh, ospecs)
         )
-        batch_spec = P("dp") if has_dp else P()
+        # the a2a step shards tokens over dp AND ep (each device routes
+        # its own token shard to the expert owners) — not dp alone
+        batch_spec = P(("dp", "ep")) if has_dp else P("ep")
 
     def init(key):
         params = init_transformer_lm(key, cfg)

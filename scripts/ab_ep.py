@@ -1,0 +1,82 @@
+"""Dense-ep (GSPMD one-hot dispatch, rounds <=4) vs indexed-ep (explicit
+all-to-all + local sorted compute, round 5) A/B on the virtual 8-device
+CPU mesh — multi-chip TPU hardware is not available, so the recorded
+observables are hardware-independent: compiled per-step FLOPs
+(XLA cost analysis) and bytes moved, plus the CPU-mesh wall for
+completeness. The single-chip analogue of this comparison is measured on
+real hardware in results/moe_v5e.txt (dense 33.4k vs sorted 51.0k tok/s
+at b16 — the dispatch rewrite the a2a step inherits).
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/ab_ep.py
+"""
+
+import time
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.models.transformer import config_for_size
+from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+from cs336_systems_tpu.parallel.ep import make_ep_train_step, shard_params_ep
+from cs336_systems_tpu.parallel.mesh import make_mesh, shard_batch
+from cs336_systems_tpu.train import init_train_state
+
+
+def main() -> None:
+    # quarter-scale E8k2 backbone: the dense/a2a dispatch FLOP ratio is
+    # structural (O(T*E*C*D) vs O(T*k*D) movement), not size-dependent,
+    # and the full "small" config does not compile+run in reasonable
+    # time on the 8-virtual-device CPU mesh
+    from cs336_systems_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=1024, context_length=128, d_model=128,
+        num_layers=2, num_heads=4, d_ff=512, compute_dtype="float32",
+        attn_impl="xla", scan_layers=True, num_experts=8, moe_top_k=2,
+        moe_capacity_factor=1.25, moe_dispatch="sorted",
+    )
+    hp = AdamWHparams(lr=3e-4)
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    batch = 16
+    params, _ = init_train_state(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (batch, 128), 0,
+                           cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+
+    for variant, axes in (("a2a", ("dp", "ep")), ("dense", ("dp",))):
+        print("lowering", variant, flush=True)
+        import dataclasses
+
+        vcfg = cfg if variant == "a2a" else dataclasses.replace(
+            cfg, moe_dispatch="dense")
+        p = shard_params_ep(params, mesh, vcfg)
+        o = adamw_init(p)
+        step = make_ep_train_step(vcfg, hp, mesh, donate=False,
+                                  variant=variant)
+        xs, ys = shard_batch(mesh, x, y, axis=axes)
+        lowered = jax.jit(step).lower(p, o, xs, ys) if not hasattr(
+            step, "lower") else step.lower(p, o, xs, ys)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = ca.get("flops", float("nan"))
+        bytes_ = ca.get("bytes accessed", float("nan"))
+        # wall: warmup + 3 fenced steps
+        out = compiled(p, o, xs, ys)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = compiled(p, o, xs, ys)
+            jax.block_until_ready(out)
+        wall = (time.perf_counter() - t0) / 3
+        print(f"{variant:6s} flops/step {flops:.3e}  bytes {bytes_:.3e}  "
+              f"cpu-mesh wall {wall * 1e3:8.1f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

@@ -374,7 +374,86 @@ def test_moe_all_experts_get_gradients():
     assert float(jnp.max(jnp.abs(g["blocks"]["ffn"]["router"]["weight"]))) > 0.0
 
 
-def test_ep_step_matches_unsharded():
+@pytest.mark.parametrize("mesh_axes,dp", [
+    ({"dp": 2, "ep": 4}, "dp"),
+    ({"ep": 8}, None),
+])
+def test_ep_a2a_step_matches_unsharded(mesh_axes, dp):
+    """THE ep oracle (round-5 indexed path): the all-to-all expert-parallel
+    step — tokens sharded over (dp ×) ep, expert weights/moments sharded
+    over ep, routed rows moved by explicit all-to-alls, local sorted
+    compute — must reproduce the single-device full-batch SORTED step:
+    same loss, same updated params. moe_capacity_factor=1.0 so routing
+    pressure is real; the global-fill-order contract decides which claims
+    drop identically to the full batch."""
+    from cs336_systems_tpu.parallel.mesh import shard_batch
+
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="sorted",
+                              moe_capacity_factor=1.0)
+    mesh = make_mesh(mesh_axes)
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    ref = make_train_step(cfg, hp, donate=False)
+    p_ref, o_ref, l_ref = ref(params, opt, x, y)
+
+    p_ep = shard_params_ep(params, mesh, cfg)
+    o_ep = adamw_init(p_ep)
+    step = make_ep_train_step(cfg, hp, mesh, donate=False, dp_axis=dp)
+    axes = (dp, "ep") if dp else ("ep",)
+    xs, ys = shard_batch(mesh, x, y, axis=axes)
+    p_ep, o_ep, l_ep = step(p_ep, o_ep, xs, ys)
+
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_ep, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ep_a2a_matches_under_forced_drops():
+    """Skew the router so one expert overflows its capacity by a wide
+    margin: the a2a step's drop decisions (global fill order across the
+    dp × ep token sharding) must still match the full-batch sorted model —
+    layer outputs AND router gradients (the kept-mask weight contract)."""
+    from cs336_systems_tpu.parallel.mesh import shard_batch
+
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="sorted",
+                              moe_capacity_factor=0.6)
+    params, opt = init_train_state(jax.random.PRNGKey(2), cfg)
+    # bias the first layer's router hard toward expert 0
+    rw = params["blocks"]["ffn"]["router"]["weight"]
+    params["blocks"]["ffn"]["router"]["weight"] = rw.at[0, 0].add(3.0)
+
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(3), (8, 32), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    ref = make_train_step(cfg, hp, donate=False)
+    p_ref, _, l_ref = ref(params, opt, x, y)
+
+    # drops are guaranteed by pigeonhole at cf=0.6: total capacity is
+    # E*ceil(k*T/E*0.6) = 8*ceil(64*0.6) = 312 < 512 = T*k total claims,
+    # so some claims drop REGARDLESS of router weights; the skew just
+    # concentrates them on one expert.
+    from cs336_systems_tpu.models.moe import moe_capacity
+
+    assert cfg.num_experts * moe_capacity(
+        256, cfg.num_experts, cfg.moe_top_k, 0.6
+    ) < 256 * cfg.moe_top_k
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    p_ep = shard_params_ep(params, mesh, cfg)
+    o_ep = adamw_init(p_ep)
+    step = make_ep_train_step(cfg, hp, mesh, donate=False)
+    xs, ys = shard_batch(mesh, x, y, axis=("dp", "ep"))
+    p_ep, _, l_ep = step(p_ep, o_ep, xs, ys)
+
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_ep, p_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ep_dense_variant_still_matches():
+    """The GSPMD-dense variant (rounds <=4) is kept for A/B and must stay
+    correct: same oracle as the a2a test, dense dispatch."""
     mesh = make_mesh({"dp": 2, "ep": 4})
     hp = AdamWHparams(lr=1e-3)
     x = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, MOE_CFG.vocab_size)
@@ -386,7 +465,8 @@ def test_ep_step_matches_unsharded():
 
     p_ep = shard_params_ep(params, mesh, MOE_CFG)
     o_ep = adamw_init(p_ep)
-    step = make_ep_train_step(MOE_CFG, hp, mesh, donate=False)
+    step = make_ep_train_step(MOE_CFG, hp, mesh, donate=False,
+                              variant="dense")
     p_ep, o_ep, l_ep = step(p_ep, o_ep, x, y)
 
     np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
