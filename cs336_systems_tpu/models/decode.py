@@ -240,8 +240,10 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
         # E/k× more than the routed work needs (binds MoE *prefill* well
         # before compute at large B·P). "gmm" packs rows tightly
         # (O(T·k·D), dropless by construction) and is the right dispatch
-        # when prefill activation memory binds; it stays opt-in via
-        # cfg.moe_dispatch pending prefill-shape validation on chip.
+        # when prefill activation memory binds; chip-validated at
+        # serving prefill shapes (results/moe_v5e.txt round-5 note:
+        # B·P=8192 logits agree with sorted to bf16 dot-order). It stays
+        # opt-in via cfg.moe_dispatch pending a trained-model token A/B.
         dispatch = "gmm" if cfg.moe_dispatch == "gmm" else "sorted"
         out, _aux = moe_ffn(
             ffn_params, x, cfg.moe_top_k, cfg.moe_capacity_factor, cfg.cdtype,

@@ -498,7 +498,7 @@ def _moe_ffn_ep_a2a(params, xt, top_k, capacity, compute_dtype,
 
 
 def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
-                 ffn_remat: bool, bm: int = 128):
+                 ffn_remat: bool, bm: int = 256):
     """DROPLESS dispatch over the Pallas grouped matmul
     (ops/grouped_matmul.py): tokens packed tightly by expert (per-group
     pad only to the ``bm`` row tile, ~3% at the E8k2 peak vs the capacity
@@ -508,7 +508,8 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
     drops, so per-shard compute already equals the full-batch model —
     routing runs locally, no fill-position all-gathers).
     """
-    from cs336_systems_tpu.ops.grouped_matmul import grouped_matmul, tile_maps
+    from cs336_systems_tpu.ops.grouped_matmul import (
+        grouped_matmul, grouped_matmul_w13, tile_maps)
 
     t, d = xt.shape
     e = params["router"]["weight"].shape[0]
@@ -551,11 +552,18 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
     def expert_ffn(wp, xs):
         # grouped_matmul consumes the native [E, out, in] layers.linear
         # layout directly (its kernels pick contracting dims) — only the
-        # bf16 cast materializes, same as the capacity paths.
+        # bf16 cast materializes, same as the capacity paths. The gate/up
+        # pair + silu·mul run as ONE fused kernel (grouped_matmul_w13):
+        # h and g never leave VMEM, x is read once, and the separate
+        # elementwise silu pass — the attributed reason gmm lost
+        # end-to-end despite winning in isolation — is gone.
+        from cs336_systems_tpu.ops.grouped_matmul import grouped_matmul_w13
+
         cast = lambda a: a.astype(in_dtype)
-        h = grouped_matmul(xs, cast(wp["w1"]["weight"]), te, first, visited, bm)
-        g = grouped_matmul(xs, cast(wp["w3"]["weight"]), te, first, visited, bm)
-        p = (jax.nn.silu(h) * g).astype(in_dtype)
+        p = grouped_matmul_w13(
+            xs, cast(wp["w1"]["weight"]), cast(wp["w3"]["weight"]),
+            te, first, visited, bm,
+        )
         return grouped_matmul(p, cast(wp["w2"]["weight"]), te, first, visited, bm)
 
     if ffn_remat:

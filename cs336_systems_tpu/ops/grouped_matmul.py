@@ -110,6 +110,65 @@ def _gmm_dw_kernel(te_ref, first_ref, dy_ref, x_ref, dw_ref):
         dw_ref[:] = dw_ref[:] + contrib
 
 
+def _silu_mul(h, g, out_dtype):
+    """silu(h)·g with the SAME dtype staging as the unfused path: the
+    plain gmm forward casts h/g to the compute dtype before XLA's silu,
+    so the fused kernel rounds its fp32 accumulators to ``out_dtype``
+    first — keeping the two gmm forms numerically aligned (the
+    equivalence tests compare them at tight tolerance)."""
+    hc = h.astype(out_dtype).astype(jnp.float32)
+    gc = g.astype(out_dtype).astype(jnp.float32)
+    return (hc * jax.nn.sigmoid(hc) * gc).astype(out_dtype)
+
+
+def _silu_mul_grads(h, g, dp):
+    """(dh, dg) of p = silu(h)·g, fp32 in/out (matches autodiff of the
+    unfused graph up to the rounding noted in ``_silu_mul``)."""
+    sig = jax.nn.sigmoid(h)
+    silu = h * sig
+    dh = dp * g * (sig + silu * (1.0 - sig))
+    dg = dp * silu
+    return dh, dg
+
+
+def _gmm13_fwd_kernel(te_ref, x_ref, w1_ref, w3_ref, p_ref):
+    del te_ref
+    h = jax.lax.dot_general(
+        x_ref[:], w1_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    g = jax.lax.dot_general(
+        x_ref[:], w3_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p_ref[:] = _silu_mul(h, g, p_ref.dtype)
+
+
+def _gmm13_fwd_hg_kernel(te_ref, x_ref, w1_ref, w3_ref, p_ref, h_ref, g_ref):
+    """Training-forward variant: also write the pre-activation h and the
+    gate g (the silu·mul backward's residuals). STORING them costs 2
+    [M, N] writes; RECOMPUTING them in the backward costs two more
+    grouped matmuls (~400 GF/layer at the E8k2 b40 cell ≈ 5x the bytes
+    they avoid — arithmetic intensity). The first cut of this kernel
+    pair carried BOTH this recompute AND the bad grid layout
+    (``_w13_specs``) and measured 50.1k → 45.4k tok/s; the two causes
+    were identified from the trace + FLOP arithmetic, not isolated
+    separately — the store+flip redesign measured 53.9k
+    (results/moe_v5e.txt round-5 note)."""
+    del te_ref
+    h = jax.lax.dot_general(
+        x_ref[:], w1_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    g = jax.lax.dot_general(
+        x_ref[:], w3_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    h_ref[:] = h.astype(h_ref.dtype)
+    g_ref[:] = g.astype(g_ref.dtype)
+    p_ref[:] = _silu_mul(h, g, p_ref.dtype)
+
+
 def float0_like(a):
     """Symbolic-zero cotangent for an integer/bool primal in a custom_vjp
     backward (shared by models/moe.py's dispatch/combine vjps)."""
@@ -211,9 +270,19 @@ def _gmm_bwd(bm, interpret, res, dy):
         return (dx, dw.astype(w.dtype), float0_like(tile_expert),
                 float0_like(tile_first), float0_like(visited))
 
-    # dx[m, i] = dy[m, o] · w[o, i] (contract out dim; w native layout)
+    dx = _dx_call(dy, w, tile_expert, bm, interpret)
+    dw = _dw_call(dy, x, w, tile_expert, tile_first, visited, bm, interpret)
+    return (dx, dw, float0_like(tile_expert),
+            float0_like(tile_first), float0_like(visited))
+
+
+def _dx_call(dy, w, tile_expert, bm, interpret):
+    """dx[m, i] = dy[m, o] · w[o, i] (contract out dim; w native layout).
+    Shared by the plain gmm backward and the fused-w13 backward."""
+    m = dy.shape[0]
+    e, n, k = w.shape
     bk = _pick_tile(k, n, w.dtype.itemsize)
-    dx = pl.pallas_call(
+    return pl.pallas_call(
         _gmm_dx_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -230,8 +299,13 @@ def _gmm_bwd(bm, interpret, res, dy):
         interpret=interpret,
     )(tile_expert, dy, w.reshape(e * n, k))
 
-    # dw[e][o, i] = Σ_{rows of e} dy[m, o] · x[m, i] — fp32 accumulation
-    # over consecutive same-expert row tiles (grid (jn, jk, i), i fastest)
+
+def _dw_call(dy, x, w, tile_expert, tile_first, visited, bm, interpret):
+    """dw[e][o, i] = Σ_{rows of e} dy[m, o] · x[m, i] — fp32 accumulation
+    over consecutive same-expert row tiles (grid (jn, jk, i), i fastest).
+    Returns in ``w``'s dtype with never-visited experts zeroed."""
+    m, k = x.shape
+    e, n, _ = w.shape
     bn_w = _pick_tile(n, k, 4)
     bk_w = _pick_tile(k, bn_w, 4)
     dw = pl.pallas_call(
@@ -252,12 +326,162 @@ def _gmm_bwd(bm, interpret, res, dy):
         interpret=interpret,
     )(tile_expert, tile_first, dy, x).reshape(e, n, k)
     dw = jnp.where(visited.astype(bool)[:, None, None], dw, 0)
-
-    return (dx, dw.astype(w.dtype), float0_like(tile_expert),
-            float0_like(tile_first), float0_like(visited))
+    return dw.astype(w.dtype)
 
 
 grouped_matmul.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def _w13_specs(m, n, k, bm, bn, n_out):
+    """Grid/spec plan shared by the two fused-w13 forward kernels.
+
+    Grid is (n-tiles, m-tiles) with the ROW dim innermost: for a fixed
+    out-tile j the weight block index (te[i]·nb + j) changes only at
+    expert boundaries, preserving the full-weight-residency property
+    that makes 128-row tiles viable (module docstring). The first cut
+    used (m, n) with n innermost — the two halved weight blocks then
+    re-DMA'd on EVERY grid step; that cut (which ALSO recomputed h/g in
+    its backward, see ``_gmm13_fwd_hg_kernel`` — the 45.4k regression
+    was measured with both defects together) motivated the flip, which
+    costs only an extra x-block read per out-tile (the small operand)."""
+    nb = n // bn
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, m // bm),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda j, i, te: (i, 0)),
+            pl.BlockSpec(
+                (bn, k), lambda j, i, te, nb=nb: (te[i] * nb + j, 0)
+            ),
+            pl.BlockSpec(
+                (bn, k), lambda j, i, te, nb=nb: (te[i] * nb + j, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda j, i, te: (i, j))
+            for _ in range(n_out)
+        ],
+    )
+
+
+def _w13_einsum_hg(x, w1, w3, tile_expert, bm, m, e):
+    onehot = _row_onehot(tile_expert, bm, m, e, jnp.float32)
+    x32 = x.astype(jnp.float32)
+    h = jnp.einsum("me,mk,enk->mn", onehot, x32, w1.astype(jnp.float32))
+    g = jnp.einsum("me,mk,enk->mn", onehot, x32, w3.astype(jnp.float32))
+    return h, g
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def grouped_matmul_w13(x, w1, w3, tile_expert, tile_first, visited,
+                       bm: int = 128, interpret: bool | None = None):
+    """FUSED expert gate/up + activation: p = silu(x @ w1ᵀ) · (x @ w3ᵀ)
+    per row group — one kernel.
+
+    The unfused gmm chain ran w1 and w3 as separate grouped matmuls and
+    left silu·mul to XLA: h and g each round-tripped HBM ([M, d_ff]
+    writes + reads) and the elementwise pass ran as its own fusion —
+    the attributed reason dropless gmm lost end-to-end to the capacity
+    path despite winning in isolation (results/moe_v5e.txt). Here x is
+    read once and h/g stay in VMEM accumulators on the inference path;
+    the TRAINING forward (the vjp-fwd variant) additionally writes h
+    and g as the silu·mul backward's residuals — storing them measures
+    ~5x cheaper than recomputing at these shapes (see
+    ``_gmm13_fwd_hg_kernel``). The backward is XLA elementwise dh/dg
+    from the stored h/g plus the SHARED grouped dx/dw kernels
+    (``_dx_call``/``_dw_call``) per weight.
+
+    Same contracts as ``grouped_matmul`` (rows grouped by expert, bm
+    tiles, native [E, N, K] weight layout, ``tile_maps`` operands);
+    differentiable in x/w1/w3. Numerics stage h/g through the compute
+    dtype (``_silu_mul``) so the fused and unfused forms agree at test
+    tolerance.
+    """
+    interpret = _resolve_interpret(interpret)
+    m, k = x.shape
+    e, n, k2 = w1.shape
+    assert w3.shape == w1.shape and k2 == k and m % bm == 0, (
+        x.shape, w1.shape, w3.shape, bm)
+    if interpret and _vma_varying(x, w1, w3, tile_expert):
+        h, g = _w13_einsum_hg(x, w1, w3, tile_expert, bm, m, e)
+        return _silu_mul(h, g, x.dtype)
+    # two weight blocks share the VMEM envelope -> halve the per-block
+    # budget by doubling the itemsize handed to the tile picker
+    bn = _pick_tile(n, k, 2 * w1.dtype.itemsize)
+    p = pl.pallas_call(
+        _gmm13_fwd_kernel,
+        grid_spec=_w13_specs(m, n, k, bm, bn, 1),
+        out_shape=[_out_sds((m, n), x.dtype, x, w1)],
+        interpret=interpret,
+    )(tile_expert, x, w1.reshape(e * n, k), w3.reshape(e * n, k))[0]
+    return p
+
+
+def _gmm13_fwd(x, w1, w3, tile_expert, tile_first, visited, bm, interpret):
+    interpret_r = _resolve_interpret(interpret)
+    m, k = x.shape
+    e, n, _ = w1.shape
+    if interpret_r and _vma_varying(x, w1, w3, tile_expert):
+        h, g = _w13_einsum_hg(x, w1, w3, tile_expert, bm, m, e)
+        p = _silu_mul(h, g, x.dtype)
+        h, g = h.astype(x.dtype), g.astype(x.dtype)
+    else:
+        bn = _pick_tile(n, k, 2 * w1.dtype.itemsize)
+        p, h, g = pl.pallas_call(
+            _gmm13_fwd_hg_kernel,
+            grid_spec=_w13_specs(m, n, k, bm, bn, 3),
+            out_shape=[
+                _out_sds((m, n), x.dtype, x, w1),
+                _out_sds((m, n), x.dtype, x, w1),
+                _out_sds((m, n), x.dtype, x, w3),
+            ],
+            interpret=interpret_r,
+        )(tile_expert, x, w1.reshape(e * n, k), w3.reshape(e * n, k))
+    return p, (x, w1, w3, h, g, tile_expert, tile_first, visited)
+
+
+def _gmm13_bwd(bm, interpret, res, dp):
+    x, w1, w3, h, g, tile_expert, tile_first, visited = res
+    interpret_r = _resolve_interpret(interpret)
+    m, k = x.shape
+    e, n, _ = w1.shape
+
+    # dh/dg from the STORED residuals — one elementwise pass XLA fuses
+    # (the compute-dtype staging matches the unfused path's autodiff)
+    dh32, dg32 = _silu_mul_grads(
+        h.astype(jnp.float32), g.astype(jnp.float32),
+        dp.astype(jnp.float32),
+    )
+    dh = dh32.astype(dp.dtype)
+    dg = dg32.astype(dp.dtype)
+
+    if interpret_r and _vma_varying(x, w1, w3, dp, tile_expert):
+        onehot = _row_onehot(tile_expert, bm, m, e, jnp.float32)
+        x32 = x.astype(jnp.float32)
+        dx = (jnp.einsum("me,mn,enk->mk", onehot, dh32,
+                         w1.astype(jnp.float32))
+              + jnp.einsum("me,mn,enk->mk", onehot, dg32,
+                           w3.astype(jnp.float32))).astype(dp.dtype)
+        dw1 = jnp.einsum("me,mn,mk->enk", onehot, dh32, x32)
+        dw3 = jnp.einsum("me,mn,mk->enk", onehot, dg32, x32)
+        mask = visited.astype(bool)[:, None, None]
+        return (dx, jnp.where(mask, dw1, 0).astype(w1.dtype),
+                jnp.where(mask, dw3, 0).astype(w3.dtype),
+                float0_like(tile_expert), float0_like(tile_first),
+                float0_like(visited))
+
+    dx = (_dx_call(dh, w1, tile_expert, bm, interpret_r).astype(jnp.float32)
+          + _dx_call(dg, w3, tile_expert, bm, interpret_r)).astype(dp.dtype)
+    dw1 = _dw_call(dh, x, w1, tile_expert, tile_first, visited, bm,
+                   interpret_r)
+    dw3 = _dw_call(dg, x, w3, tile_expert, tile_first, visited, bm,
+                   interpret_r)
+    return (dx, dw1, dw3,
+            float0_like(tile_expert), float0_like(tile_first),
+            float0_like(visited))
+
+
+grouped_matmul_w13.defvjp(_gmm13_fwd, _gmm13_bwd)
 
 
 def tile_maps(counts: jax.Array, bm: int, n_tiles: int):

@@ -518,3 +518,51 @@ def test_pp_rejects_moe():
     mesh = make_mesh({"pp": 2})
     with pytest.raises(ValueError, match="MoE blocks under pipeline"):
         validate_pp(MOE_CFG, mesh)
+
+
+def test_gmm_w13_fused_matches_unfused_chain():
+    """grouped_matmul_w13 (one fused gate/up+silu·mul kernel, interpret
+    mode) == the unfused chain (two grouped_matmuls + XLA silu·mul) —
+    values AND all three gradients, including an empty expert, uneven
+    group sizes, and pad rows inside a tile."""
+    from cs336_systems_tpu.ops.grouped_matmul import (
+        grouped_matmul,
+        grouped_matmul_w13,
+        tile_maps,
+    )
+
+    d, f, e, bm = 16, 32, 4, 8
+    counts = jnp.asarray([10, 0, 5, 3], jnp.int32)
+    m_pad = (int(jnp.sum((counts + bm - 1) // bm * bm)) // bm + 2) * bm
+    te, first, visited, starts = tile_maps(counts, bm, m_pad // bm)
+    used = int(starts[-1])
+    kx, k1, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    x = jnp.zeros((m_pad, d))
+    for g, c in enumerate(np.asarray(counts)):
+        s = int(starts[g])
+        x = x.at[s:s + int(c)].set(
+            jax.random.normal(jax.random.fold_in(kx, g), (int(c), d)))
+    w1 = jax.random.normal(k1, (e, f, d)) * 0.3
+    w3 = jax.random.normal(k3, (e, f, d)) * 0.3
+
+    def fused(args):
+        x, w1, w3 = args
+        return grouped_matmul_w13(x, w1, w3, te, first, visited, bm)
+
+    def unfused(args):
+        x, w1, w3 = args
+        h = grouped_matmul(x, w1, te, first, visited, bm)
+        g = grouped_matmul(x, w3, te, first, visited, bm)
+        return (jax.nn.silu(h) * g).astype(x.dtype)
+
+    pf = fused((x, w1, w3))
+    pu = unfused((x, w1, w3))
+    np.testing.assert_allclose(np.asarray(pf[:used]), np.asarray(pu[:used]),
+                               rtol=1e-5, atol=1e-5)
+
+    loss = lambda f_: lambda a: jnp.sum(jnp.sin(f_(a)[:used] * 3.0))
+    gf = jax.grad(loss(fused))((x, w1, w3))
+    gu = jax.grad(loss(unfused))((x, w1, w3))
+    for a, b, name in zip(gf, gu, ("dx", "dw1", "dw3")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
