@@ -557,8 +557,6 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
         # h and g never leave VMEM, x is read once, and the separate
         # elementwise silu pass — the attributed reason gmm lost
         # end-to-end despite winning in isolation — is gone.
-        from cs336_systems_tpu.ops.grouped_matmul import grouped_matmul_w13
-
         cast = lambda a: a.astype(in_dtype)
         p = grouped_matmul_w13(
             xs, cast(wp["w1"]["weight"]), cast(wp["w3"]["weight"]),

@@ -334,12 +334,42 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None, ring_rope=None):
             )(q, k, v)
         return local_attn(q, k, v)
     elif cfg.attn_impl == "ring":
-        # sequence-parallel exact attention: must be called inside a
-        # shard_map whose mesh has cfg.sp_axis; q/k/v here hold the LOCAL
-        # sequence shard, and positions carry the global offsets. The
-        # per-hop inner op is the flash kernel (window → truncated ring).
         from cs336_systems_tpu.parallel.ring import ring_attention
 
+        if cfg.attn_batch_shard or cfg.attn_head_shard:
+            # GSPMD composition (dp × tp × sp): like the flash branch, the
+            # ring runs in its OWN shard_map island — operands arrive
+            # GSPMD-sharded [B/dp, H/tp, S/sp, Dh] with rope already
+            # applied outside at global positions (the builder forces
+            # rope_fused off for this path; _mha never builds ring_rope
+            # when shard axes are declared), and the ring's K/V ppermute
+            # hops ride the sp axis inside the island.
+            if mesh is None:
+                raise ValueError(
+                    "cfg declares attention sharding but no mesh was "
+                    "passed to the apply fn"
+                )
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(cfg.attn_batch_shard, cfg.attn_head_shard, cfg.sp_axis)
+
+            def local_ring(q, k, v):
+                b, h, s, dh = q.shape
+                fold = lambda x: x.reshape(b * h, s, dh)
+                out = ring_attention(
+                    fold(q), fold(k), fold(v), axis=cfg.sp_axis,
+                    causal=True, window=cfg.attn_window,
+                )
+                return out.reshape(b, h, s, dh)
+
+            return jax.shard_map(
+                local_ring, mesh=mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+            )(q, k, v)
+
+        # inside-shard_map form: q/k/v hold the LOCAL sequence shard and
+        # positions carry the global offsets. The per-hop inner op is the
+        # flash kernel (window → truncated ring).
         b, h, s, dh = q.shape
         fold = lambda x: x.reshape(b * h, s, dh)
         rope_kw = {}
@@ -446,11 +476,14 @@ def _mha(block_params, x, cos, sin, positions, cfg: TransformerConfig,
         k = split(linear(p["k_proj"], x, cfg.cdtype))
         v = split(linear(p["v_proj"], x, cfg.cdtype))
     ring_rope = None
-    if cfg.attn_impl == "ring" and cfg.rope_fused and positions.ndim == 1:
+    if (cfg.attn_impl == "ring" and cfg.rope_fused and positions.ndim == 1
+            and not (cfg.attn_batch_shard or cfg.attn_head_shard)):
         # rotate inside the ring hops' kernels (parallel/ring.py) — no
         # rope op between the projections and the custom calls, matching
         # the single-device fused-rope default. Per-batch positions fall
-        # back to the XLA rotation (the per-row table API is shared-[S]).
+        # back to the XLA rotation (the per-row table API is shared-[S]);
+        # so does the GSPMD dp×tp×sp island (shard axes declared), whose
+        # rope applies outside at global positions.
         ring_rope = (cos, sin, positions)
     else:
         with jax.named_scope("rope"):

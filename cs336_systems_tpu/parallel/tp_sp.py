@@ -1,0 +1,107 @@
+"""Three-axis composed training: dp × tp × sp in ONE jitted step —
+Megatron tensor parallelism AND ring-attention sequence parallelism AND
+data parallelism over a single 3-D mesh.
+
+Capability beyond the reference (DP-only, SURVEY §2.3) and beyond this
+repo's own rounds 1-4, whose model-parallel families each composed only
+with dp. This is what makes the parallelism surface a FRAMEWORK rather
+than a collection of 2-axis modes: the same GSPMD mechanism that
+composes tp with dp (parallel/tp.py) extends to the sequence axis, and
+the ring attention — previously reachable only from inside the
+sp-owned shard_map (parallel/sp.py) — now runs as its own shard_map
+island under the GSPMD jit, exactly like the flash kernel's island
+(models/transformer._attention).
+
+Layout:
+- params / moments: the Megatron tp specs (parallel/tp.param_specs —
+  column/row-parallel projections + SwiGLU, vocab-parallel LM head);
+- x, y: [batch/dp, S/sp];
+- rope: applied OUTSIDE the ring at global positions (GSPMD shards the
+  position gather over sp; the in-kernel fused-rope variant stays the
+  sp-only path's optimization — forcing it off here changes layout,
+  not math);
+- attention: ring K/V ppermute hops over sp INSIDE the island, heads
+  already local to each tp shard, batch local to each dp shard;
+- loss: cross-entropy over the vocab-sharded logits — XLA partial-sums
+  the vocab reduction and averages over the (dp × sp)-sharded tokens,
+  the same propagation the 2-axis tp step relies on.
+
+Equivalence to the single-device step is oracle-TESTED (the ring is
+exact attention; TP/SP are layouts), not assumed — tests/test_tp_sp.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cs336_systems_tpu.models.transformer import TransformerConfig
+from cs336_systems_tpu.optim.adamw import AdamWHparams
+from cs336_systems_tpu.parallel.tp import opt_state_specs, param_specs, validate_tp
+
+
+def validate_tp_sp(cfg: TransformerConfig, mesh: Mesh,
+                   tp_axis: str = "tp", sp_axis: str = "sp") -> None:
+    validate_tp(cfg, mesh, tp_axis)
+    if sp_axis not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {sp_axis!r} axis")
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MoE under the tp×sp composition is not supported (the sp "
+            "family rejects MoE — parallel/sp.py; shard experts over ep)"
+        )
+
+
+def make_tp_sp_train_step(
+    cfg: TransformerConfig,
+    hp: AdamWHparams,
+    mesh: Mesh,
+    clip_norm: float | None = 1.0,
+    lr_schedule: Callable | None = None,
+    dp_axis: str | None = "dp",
+    tp_axis: str = "tp",
+    sp_axis: str = "sp",
+    donate: bool = True,
+) -> Callable:
+    """Jitted (dp ×) tp × sp train step: ``(params, opt, x, y) ->
+    (params, opt, loss)`` with params head/ff/vocab-sharded over
+    ``tp_axis`` and x/y sharded [dp_axis, sp_axis].
+
+    Sequence length must cover the full context per shard group
+    (S ≤ cfg.context_length as usual; S divisible by the sp degree for
+    an even layout — GSPMD would accept ragged but the ring island's
+    shard_map requires even blocks).
+    """
+    from cs336_systems_tpu.parallel.mesh import named_sharding_tree
+    from cs336_systems_tpu.train import lm_loss, make_update_fn
+
+    validate_tp_sp(cfg, mesh, tp_axis, sp_axis)
+    have_dp = bool(dp_axis) and dp_axis in mesh.shape
+    rcfg = dataclasses.replace(
+        cfg,
+        attn_impl="ring",
+        sp_axis=sp_axis,
+        rope_fused=False,  # rope outside the island (module docstring)
+        attn_batch_shard=dp_axis if have_dp else None,
+        attn_head_shard=tp_axis,
+        attn_fold="bh",  # the island specs [B, H, S, Dh] axes
+    )
+    pspecs = param_specs(cfg, tp_axis)
+    ospecs = opt_state_specs(cfg, tp_axis)
+    bspec = P(dp_axis if have_dp else None, sp_axis)
+    sh = functools.partial(named_sharding_tree, mesh)
+
+    step = make_update_fn(
+        functools.partial(lm_loss, cfg=rcfg, mesh=mesh), hp, clip_norm,
+        lr_schedule,
+    )
+    return jax.jit(
+        step,
+        in_shardings=(sh(pspecs), sh(ospecs), sh(bspec), sh(bspec)),
+        out_shardings=(sh(pspecs), sh(ospecs), sh(P())),
+        donate_argnums=(0, 1) if donate else (),
+    )

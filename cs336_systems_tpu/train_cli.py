@@ -10,7 +10,8 @@ params/optimizer/step resume in EVERY mode (sharded modes persist their
 re-chunked if the device count changed; the data stream re-seeds by resume
 step so no consumed batch repeats), and the parallelism layer selected by
 flag: single device, the DP variants, DP+ZeRO-1, FSDP, and the mesh modes
-tp / sp / pp / ep (with ``--mesh dp=2,tp=4``-style shapes).
+tp / sp / pp / ep / tp_sp (with ``--mesh dp=2,tp=4``- or
+``dp=2,tp=2,sp=2``-style shapes).
 
 Examples::
 
@@ -31,6 +32,10 @@ Examples::
     python -m cs336_systems_tpu.train_cli --synthetic --parallel sp
     python -m cs336_systems_tpu.train_cli --synthetic --parallel pp --microbatches 8
     python -m cs336_systems_tpu.train_cli --synthetic --parallel ep --experts 8
+
+    # 3-axis composition: data x tensor x sequence parallel in one step
+    python -m cs336_systems_tpu.train_cli --synthetic --parallel tp_sp \
+        --mesh dp=2,tp=2,sp=2
 """
 
 from __future__ import annotations
@@ -247,7 +252,7 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             lambda s: s,  # the whole state (fp32 master chunks + m/v + t)
             lambda ck: fsdp_restore(_require_opt(ck), params_like, mesh),
         )
-    if parallel in ("tp", "sp", "pp", "ep"):
+    if parallel in ("tp", "sp", "pp", "ep", "tp_sp"):
         return _build_mesh_mode(
             cfg, hp, schedule, parallel, donate, mesh_axes, microbatches
         )
@@ -267,13 +272,25 @@ def _build_mesh_mode(cfg, hp, schedule, parallel, donate, mesh_axes,
     from cs336_systems_tpu.parallel.mesh import make_mesh, shard_tree
 
     n_dev = len(jax.devices())
-    inner = parallel  # axis name matches the mode
-    mesh = make_mesh(mesh_axes or {inner: n_dev})
-    if inner not in mesh.shape:
-        raise SystemExit(
-            f"--parallel {parallel} needs a {inner!r} mesh axis; got "
-            f"--mesh {dict(mesh.shape)}"
-        )
+    if parallel == "tp_sp":
+        # 3-axis composition: default mesh splits devices tp × sp evenly
+        need = ("tp", "sp")
+        if not mesh_axes and n_dev % 2:
+            raise SystemExit(
+                f"--parallel tp_sp has no even default mesh for {n_dev} "
+                "device(s); pass --mesh tp=..,sp=.. (optionally dp=..) "
+                "with a product matching the device count"
+            )
+        mesh = make_mesh(mesh_axes or {"tp": n_dev // 2, "sp": 2})
+    else:
+        need = (parallel,)
+        mesh = make_mesh(mesh_axes or {parallel: n_dev})
+    for ax in need:
+        if ax not in mesh.shape:
+            raise SystemExit(
+                f"--parallel {parallel} needs a {ax!r} mesh axis; got "
+                f"--mesh {dict(mesh.shape)}"
+            )
     has_dp = "dp" in mesh.shape
 
     if parallel == "tp":
@@ -296,6 +313,21 @@ def _build_mesh_mode(cfg, hp, schedule, parallel, donate, mesh_axes,
             cfg, hp, mesh, lr_schedule=schedule, donate=donate
         )
         place = lambda p, o: (p, o)  # replicated
+        batch_spec = P("dp" if has_dp else None, "sp")
+    elif parallel == "tp_sp":
+        from cs336_systems_tpu.parallel import tp as tp_mode
+        from cs336_systems_tpu.parallel import tp_sp as mode
+        from cs336_systems_tpu.parallel.mesh import adamw_state_specs
+
+        step = mode.make_tp_sp_train_step(
+            cfg, hp, mesh, lr_schedule=schedule, donate=donate,
+            dp_axis="dp" if has_dp else None,
+        )
+        pspecs = tp_mode.param_specs(cfg)
+        ospecs = adamw_state_specs(pspecs)
+        place = lambda p, o: (
+            shard_tree(p, mesh, pspecs), shard_tree(o, mesh, ospecs)
+        )
         batch_spec = P("dp" if has_dp else None, "sp")
     elif parallel == "pp":
         from cs336_systems_tpu.parallel import pp as mode
@@ -372,7 +404,7 @@ def main(argv=None) -> None:
                    help="attention impl (default flash on TPU, xla elsewhere)")
     p.add_argument("--parallel", default="none",
                    choices=["none", "naive", "flat", "bucketed", "zero1",
-                            "fsdp", "tp", "sp", "pp", "ep"])
+                            "fsdp", "tp", "sp", "pp", "ep", "tp_sp"])
     p.add_argument("--mesh", default=None,
                    help="mesh shape for the sharded modes, e.g. 'dp=2,tp=4' "
                         "(default: all devices on the mode's own axis)")

@@ -273,3 +273,17 @@ def test_cli_eval_split(capsys):
     evals = [l for l in out.splitlines() if "eval_loss" in l]
     assert len(evals) >= 2, out
     assert all(float(l.split("eval_loss")[1]) < 10 for l in evals)
+
+
+def test_cli_tp_sp_mode_trains(capsys):
+    """--parallel tp_sp (the 3-axis dp x tp x sp composition) trains with
+    finite decreasing-ish loss through the CLI wiring."""
+    main(TINY + ["--steps", "6", "--parallel", "tp_sp",
+                 "--mesh", "dp=2,tp=2,sp=2"])
+    out = capsys.readouterr().out
+    losses = [
+        float(l.split("loss")[1].split()[0])
+        for l in out.splitlines()
+        if l.startswith("step") and "eval" not in l
+    ]
+    assert len(losses) >= 2 and np.isfinite(losses).all()
