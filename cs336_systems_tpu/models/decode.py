@@ -230,6 +230,18 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
     if cfg.num_experts > 0:
         from cs336_systems_tpu.models.moe import moe_ffn
 
+        if cfg.moe_ep_axis is not None:
+            # EXPERT-SHARDED serving: tokens replicated over the ep axis,
+            # expert weights sharded over it, one psum — dropless by the
+            # same capacity argument as below (moe_ffn_ep_local docstring;
+            # parallel/serve.py builds this config).
+            from cs336_systems_tpu.models.moe import moe_ffn_ep_local
+
+            return moe_ffn_ep_local(
+                ffn_params, x, cfg.moe_top_k, cfg.cdtype,
+                ep_axis=cfg.moe_ep_axis,
+            )
+
         t = x.reshape(-1, x.shape[-1]).shape[0]
         # Serving always routes via an INDEX dispatch: the dense one-hot
         # form builds [T, E, C] dispatch tensors, and at the dropless

@@ -497,6 +497,81 @@ def _moe_ffn_ep_a2a(params, xt, top_k, capacity, compute_dtype,
     return out.astype(in_dtype), aux
 
 
+def moe_ffn_ep_local(params, x, top_k: int, compute_dtype=None,
+                     ep_axis: str = "ep", ffn_remat: bool = False):
+    """EXPERT-SHARDED serving FFN: tokens REPLICATED over ``ep_axis``,
+    expert weights sharded over it, one psum per layer.
+
+    The serving-side counterpart of the training a2a path
+    (``_moe_ffn_ep_a2a``) for the regime that motivates expert-sharded
+    decode: large-E MoE whose expert weights exceed one chip's HBM while
+    the per-step token count (B rows at decode) is small. Replicating
+    the tokens costs each shard the dense compute once, but moves ZERO
+    activation rows over the interconnect until the single fp32 psum of
+    the combined outputs — at decode token counts that psum is the
+    entire communication.
+
+    Mechanics: routing runs replicated over the full E experts (router
+    weight replicated); each shard keeps only the claims owned by its
+    E/W local experts, packs them with the gather-both-ways machinery at
+    the DROPLESS capacity (c = T: a token's top-k experts are distinct,
+    so no expert can receive more than T claims — the serving contract,
+    models/decode._ffn), computes its local experts, combines with the
+    locality-masked weights, and psums. Every (token, claim) term is
+    computed on exactly ONE shard, so the result equals the
+    single-device dropless path: BIT-EXACT for top_k ≤ 2 (the combine
+    is then at most one fp32 addition, and IEEE addition is
+    commutative); for k > 2 the shard-order summation can differ in low
+    bits from slot order (documented tolerance). Memory: the packed
+    buffer is [E/W · T, D] per shard — the same O(E·T·D)-class bound as
+    sorted-at-C=T divided by the ep degree, which is the point.
+    """
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    t = xt.shape[0]
+    e = params["router"]["weight"].shape[0]
+    e_local = params["experts"]["w1"]["weight"].shape[0]
+    if e % e_local:
+        raise ValueError(f"global experts {e} not a multiple of local {e_local}")
+    in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+
+    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    vals, idx = jax.lax.top_k(gates, top_k)  # [T, k]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    local_lo = jax.lax.axis_index(ep_axis) * e_local
+    is_local = (idx >= local_lo) & (idx < local_lo + e_local)  # [T, k]
+    eloc = jnp.clip(idx - local_lo, 0, e_local - 1)
+    flat_e = eloc.reshape(-1)
+    flat_keep = is_local.reshape(-1)
+
+    onehot = jax.nn.one_hot(flat_e, e_local, dtype=jnp.int32) * flat_keep[:, None]
+    local_rank = jnp.sum((_prefix_count(onehot) - onehot) * onehot, axis=-1)
+    c_buf = t  # dropless
+    dest = flat_e * c_buf + local_rank
+    dest_c = jnp.where(flat_keep, dest, 0)
+    src_c, valid = _invert_map(dest, flat_keep, e_local * c_buf)
+    token = jnp.repeat(jnp.arange(t), top_k)
+    tok_of_slot = jnp.take(token, src_c)
+
+    xe = _dispatch_rows(xt.astype(in_dtype), tok_of_slot, valid, dest_c,
+                        flat_keep)
+    expert_fn = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))
+    if ffn_remat:
+        expert_fn = jax.checkpoint(expert_fn)
+    ye = expert_fn(params["experts"], xe.reshape(e_local, c_buf, d))
+
+    wk = vals * is_local.astype(jnp.float32)
+    out = _combine_rows(
+        ye.reshape(e_local * c_buf, d), wk, dest_c.reshape(t, top_k),
+        src_c, valid, tok_of_slot,
+    )
+    out = jax.lax.psum(out, ep_axis)
+    return out.astype(in_dtype).reshape(*lead, d)
+
+
 def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
                  ffn_remat: bool, bm: int = 256):
     """DROPLESS dispatch over the Pallas grouped matmul

@@ -163,17 +163,14 @@ class TransformerConfig:
                 "dispatch: 'sorted', 'sorted_scatter', or 'gmm' (the dense "
                 "one-hot dispatch has no global-position form)"
             )
-        if self.moe_ep_axis is not None:
-            if self.moe_dispatch != "sorted":
-                raise ValueError(
-                    "moe_ep_axis (all-to-all expert parallelism) requires "
-                    f"moe_dispatch='sorted', got {self.moe_dispatch!r}"
-                )
-            if self.moe_dp_axis is None:
-                raise ValueError(
-                    "moe_ep_axis requires moe_dp_axis naming the token-"
-                    "sharding axes (global fill order is the ep contract)"
-                )
+        if self.moe_ep_axis is not None and self.moe_dispatch != "sorted":
+            raise ValueError(
+                "moe_ep_axis (expert parallelism) requires "
+                f"moe_dispatch='sorted', got {self.moe_dispatch!r}"
+            )
+        # (moe_dp_axis is additionally required by the TRAINING a2a path —
+        # moe_ffn raises there; expert-sharded SERVING replicates tokens
+        # over ep and needs no token axes, models/moe.moe_ffn_ep_local)
 
     @property
     def d_head(self) -> int:

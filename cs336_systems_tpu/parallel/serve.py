@@ -10,7 +10,8 @@ mesh), keeping the Pallas decode kernel's aliased in-place cache intact —
 each shard's cache leaves live in ITS memory and are updated by ITS
 kernel calls; no cache row ever crosses the interconnect.
 
-Two axes, composable in one 2-D mesh:
+Three axes (dp/tp for dense models, dp/ep for MoE), composable in one
+mesh:
 
 - ``dp`` (batch sharding): decode is embarrassingly parallel over rows —
   params and the PRNG key replicate, prompts/caches/outputs shard, and
@@ -28,6 +29,12 @@ Two axes, composable in one 2-D mesh:
   embedding/lm_head replicate: at serving batch the lm_head matmul is
   tiny, and a replicated head avoids a per-token vocab all-gather in the
   sampler.
+- ``ep`` (expert sharding, MoE): expert weights shard over the mesh
+  (1/W of the expert bytes per device — large-E MoE beyond one chip's
+  HBM), tokens replicate over ep, and each shard computes its own
+  experts' claims with ONE psum per MoE layer
+  (models/moe.moe_ffn_ep_local); bit-identical to the single-device
+  dropless path at top_k ≤ 2.
 
 Ragged batches are first-class: pass ``prompt_lens`` ([B] per-row prompt
 lengths, rows left-aligned in the padded buffer) and every row decodes
@@ -47,11 +54,20 @@ from jax import shard_map
 from cs336_systems_tpu.models.transformer import TransformerConfig
 
 
-def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None):
+def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None,
+                      ep_axis: str | None = None):
     """PartitionSpec tree for serving params: block weights head-/ff-
     sharded over ``tp_axis`` (parallel/tp.py's column/row assignment),
-    embedding + lm_head + norms replicated. All-replicated when
-    ``tp_axis`` is None."""
+    embedding + lm_head + norms replicated. With ``ep_axis`` (MoE
+    serving) the expert weights shard on their expert dim and everything
+    else replicates. All-replicated when both are None."""
+    if ep_axis is not None:
+        # the training ep layout IS the serving layout (expert leaves
+        # over ep, everything else replicated) — delegate like the tp
+        # branch does, so the param-tree structure lives in ONE place
+        from cs336_systems_tpu.parallel.ep import param_specs
+
+        return param_specs(cfg, ep_axis)
     if tp_axis is None:
         return P()
     from cs336_systems_tpu.parallel.tp import param_specs
@@ -75,6 +91,7 @@ def make_sharded_generate(
     top_p: float | None = None,
     attn_impl: str = "auto",
     approx_top_k: bool = False,
+    ep_axis: str | None = None,
 ):
     """Build a jitted sharded generation fn:
     ``(params, prompt_ids [B, P], key) -> tokens [B, max_new_tokens]``.
@@ -82,28 +99,56 @@ def make_sharded_generate(
     ``dp_axis``: mesh axis the batch shards over (B divisible by its
     size); None = no batch sharding. ``tp_axis``: mesh axis the heads /
     d_ff shard over (see module docstring); None = no tensor parallelism.
-    Tokens come back fully replicated on tp and batch-sharded on dp.
+    ``ep_axis`` (MoE only): mesh axis the EXPERT weights shard over —
+    tokens replicate over it and each shard computes its own experts'
+    claims, one psum per MoE layer (models/moe.moe_ffn_ep_local); the
+    path for expert weights beyond one chip's HBM. Composes with dp
+    ({dp: b, ep: e} meshes); tp+MoE stays excluded. Tokens come back
+    fully replicated on tp/ep and batch-sharded on dp.
 
     Equivalence to the single-device row-keyed path
     (``generate_kv_batched(..., row_keyed=True)``): the dp axis is
     bit-identical BY CONSTRUCTION (row-keyed streams depend only on
-    global row index; no collective touches activations). The tp axis
-    psums per-shard partial matmul sums, which can perturb logit low
-    bits relative to the unsharded contraction order — token equality
-    there is empirical (pinned at the tested configs by
-    tests/test_serve.py), not an invariant.
+    global row index; no collective touches activations), and so is the
+    ep axis at top_k ≤ 2 (every claim computed on exactly one shard; the
+    combine psum is then one commutative fp32 addition — the
+    moe_ffn_ep_local docstring derivation; k > 2 is documented
+    tolerance). The tp axis psums per-shard partial matmul sums, which
+    can perturb logit low bits relative to the unsharded contraction
+    order — token equality there is empirical (pinned at the tested
+    configs by tests/test_serve.py), not an invariant.
     """
-    for name, ax in (("dp_axis", dp_axis), ("tp_axis", tp_axis)):
+    for name, ax in (("dp_axis", dp_axis), ("tp_axis", tp_axis),
+                     ("ep_axis", ep_axis)):
         if ax is not None and ax not in mesh.shape:
             raise ValueError(
                 f"{name}={ax!r} is not an axis of the mesh "
                 f"{dict(mesh.shape)}; pass {name}=None to disable it"
             )
+    if ep_axis is not None:
+        if cfg.num_experts <= 0:
+            raise ValueError("ep_axis shards MoE expert weights; the "
+                             "config has num_experts=0")
+        if tp_axis is not None:
+            raise ValueError(
+                "tp+ep serving is not composed yet: tp shards the dense "
+                "block weights, which an MoE config does not have"
+            )
+        if cfg.num_experts % mesh.shape[ep_axis]:
+            raise ValueError(
+                f"num_experts={cfg.num_experts} not divisible by "
+                f"{ep_axis}={mesh.shape[ep_axis]}"
+            )
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_dispatch="sorted",
+                                  moe_ep_axis=ep_axis)
     if tp_axis is not None:
         if cfg.num_experts > 0:
             raise ValueError(
                 "tp serving shards the dense block weights; MoE serving "
-                "shards over dp (expert weights are not in the tp spec)"
+                "shards over dp and/or ep (expert weights are not in the "
+                "tp spec)"
             )
         # Only the dims the serving spec actually shards need dividing:
         # heads (q/k/v column weights + cache) and d_ff (w1/w3/w2). The
@@ -118,7 +163,7 @@ def make_sharded_generate(
 
     from cs336_systems_tpu.models.decode import _generate_scan
 
-    pspecs = serve_param_specs(cfg, tp_axis)
+    pspecs = serve_param_specs(cfg, tp_axis, ep_axis)
     batch_spec = P(dp_axis) if dp_axis is not None else P()
     temperature = float(temperature)
 
