@@ -498,7 +498,7 @@ def _moe_ffn_ep_a2a(params, xt, top_k, capacity, compute_dtype,
 
 
 def moe_ffn_ep_local(params, x, top_k: int, compute_dtype=None,
-                     ep_axis: str = "ep", ffn_remat: bool = False):
+                     ep_axis: str = "ep"):
     """EXPERT-SHARDED serving FFN: tokens REPLICATED over ``ep_axis``,
     expert weights sharded over it, one psum per layer.
 
@@ -558,9 +558,9 @@ def moe_ffn_ep_local(params, x, top_k: int, compute_dtype=None,
 
     xe = _dispatch_rows(xt.astype(in_dtype), tok_of_slot, valid, dest_c,
                         flat_keep)
+    # (no remat knob: this is a forward-only serving path — nothing is
+    # stashed for a backward, so jax.checkpoint would be a no-op trap)
     expert_fn = jax.vmap(lambda p, h: swiglu(p, h, compute_dtype))
-    if ffn_remat:
-        expert_fn = jax.checkpoint(expert_fn)
     ye = expert_fn(params["experts"], xe.reshape(e_local, c_buf, d))
 
     wk = vals * is_local.astype(jnp.float32)

@@ -62,6 +62,8 @@ def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None,
     serving) the expert weights shard on their expert dim and everything
     else replicates. All-replicated when both are None."""
     if ep_axis is not None:
+        if tp_axis is not None:
+            raise ValueError("tp+ep serving is not composed yet")
         # the training ep layout IS the serving layout (expert leaves
         # over ep, everything else replicated) — delegate like the tp
         # branch does, so the param-tree structure lives in ONE place
@@ -118,13 +120,22 @@ def make_sharded_generate(
     order — token equality there is empirical (pinned at the tested
     configs by tests/test_serve.py), not an invariant.
     """
-    for name, ax in (("dp_axis", dp_axis), ("tp_axis", tp_axis),
-                     ("ep_axis", ep_axis)):
+    axes = (("dp_axis", dp_axis), ("tp_axis", tp_axis),
+            ("ep_axis", ep_axis))
+    for name, ax in axes:
         if ax is not None and ax not in mesh.shape:
             raise ValueError(
                 f"{name}={ax!r} is not an axis of the mesh "
                 f"{dict(mesh.shape)}; pass {name}=None to disable it"
             )
+    named = [ax for _, ax in axes if ax is not None]
+    if len(set(named)) != len(named):
+        # e.g. dp_axis == ep_axis would shard the batch over the axis the
+        # MoE layer assumes tokens are REPLICATED on — the psum would then
+        # silently add DIFFERENT tokens' outputs across shards
+        raise ValueError(
+            f"dp/tp/ep axes must be distinct mesh axes, got {named}"
+        )
     if ep_axis is not None:
         if cfg.num_experts <= 0:
             raise ValueError("ep_axis shards MoE expert weights; the "

@@ -37,6 +37,8 @@ def main() -> None:
     p.add_argument("--top-k", type=int, default=2)
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--ffn-remat", action="store_true")
+    p.add_argument("--d-ff", type=int, default=None)
+    p.add_argument("--cf", type=float, default=1.25)
     p.add_argument("--logdir", default="/tmp/moe_trace")
     args = p.parse_args()
 
@@ -53,6 +55,8 @@ def main() -> None:
         moe_top_k=args.top_k,
         moe_dispatch=args.dispatch,
         moe_ffn_remat=args.ffn_remat,
+        moe_capacity_factor=args.cf,
+        **({"d_ff": args.d_ff} if args.d_ff else {}),
     )
     params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
     loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))

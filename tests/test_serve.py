@@ -200,6 +200,10 @@ def test_ep_serving_validation():
     with pytest.raises(ValueError, match="not divisible"):
         make_sharded_generate(moe, mesh, max_new_tokens=4, ep_axis="ep")
     moe8 = dataclasses.replace(CFG, num_experts=8, moe_top_k=2)
+    mesh2 = make_mesh({"tp": 2, "ep": 4})
     with pytest.raises(ValueError, match="tp\\+ep"):
-        make_sharded_generate(moe8, mesh, max_new_tokens=4, ep_axis="ep",
-                              tp_axis="dp")
+        make_sharded_generate(moe8, mesh2, max_new_tokens=4, dp_axis=None,
+                              ep_axis="ep", tp_axis="tp")
+    with pytest.raises(ValueError, match="distinct"):
+        make_sharded_generate(moe8, mesh, max_new_tokens=4, dp_axis="ep",
+                              ep_axis="ep")
