@@ -8,10 +8,9 @@ optimization arc this script steered: 2064 us/token (XLA masked softmax +
 per-token param slices) -> 1518 (fused kernel + unstacked params) -> 1070
 (packed in-place kernel) -> 792 with approx sampling, vs roofline 664.
 
-Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_decode_step.py [logdir]
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_decode_step.py [logdir] [--batch N] [--approx-top-k]
 """
 
-import sys
 
 from cs336_systems_tpu.utils.platform import honor_cpu_request
 
@@ -26,9 +25,18 @@ from cs336_systems_tpu.utils.profiling import summarize_trace, trace
 
 
 def main() -> None:
-    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/decode_trace"
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir", nargs="?", default="/tmp/decode_trace")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--approx-top-k", action="store_true")
+    args = ap.parse_args()
+    logdir = args.logdir
     on_tpu = jax.default_backend() == "tpu"
     batch, prompt, new = (32, 64, 128) if on_tpu else (2, 8, 8)
+    if args.batch is not None:
+        batch = args.batch
     cfg = config_for_size(
         "small",
         context_length=512,
@@ -42,7 +50,7 @@ def main() -> None:
     def run():
         toks = generate_kv_batched(
             params, cfg, ids, new, jax.random.PRNGKey(2),
-            temperature=0.8, top_k=50,
+            temperature=0.8, top_k=50, approx_top_k=args.approx_top_k,
         )
         jax.device_get(toks)
 

@@ -115,8 +115,9 @@ def test_serve_validation():
         gen(params, prompts, key)
     with pytest.raises(ValueError, match="MoE serving"):
         make_sharded_generate(
-            dataclasses.replace(CFG, num_experts=4), mesh,
-            max_new_tokens=8, tp_axis="dp",
+            dataclasses.replace(CFG, num_experts=4),
+            make_mesh({"dp": 2, "tp": 4}),
+            max_new_tokens=8, tp_axis="tp",
         )
 
 

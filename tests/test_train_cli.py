@@ -45,6 +45,7 @@ def _reference_loss(capsys) -> float:
     ("tp", ["--mesh", "dp=2,tp=4"]),
     ("sp", []),
     ("pp", ["--mesh", "dp=2,pp=2", "--microbatches", "2"]),
+    ("tp_sp", ["--mesh", "dp=2,tp=2,sp=2"]),
 ])
 def test_cli_parallel_modes_agree(mode, extra, capsys):
     ref = _reference_loss(capsys)
