@@ -15,6 +15,30 @@ TINY = [
 ]
 
 
+def _step_losses(out):
+    """Loss column of every training step line (eval lines excluded)."""
+    return [l.split("loss")[1].split()[0] for l in out.splitlines()
+            if l.startswith("step") and "eval" not in l]
+
+
+def _snapshot_at_step(cli, monkeypatch, src_dir, dst_dir, step):
+    """Monkeypatch save_checkpoint to copy the checkpoint dir the moment
+    the given step's checkpoint is written — the mid-run snapshot move the
+    resume-exact tests share (both runs keep the same --steps so the
+    cosine schedule matches)."""
+    import shutil
+
+    real_save = cli.save_checkpoint
+
+    def snapshotting_save(path, *a, **kw):
+        real_save(path, *a, **kw)
+        if kw.get("step") == step:
+            shutil.copytree(src_dir, dst_dir, dirs_exist_ok=True)
+
+    monkeypatch.setattr(cli, "save_checkpoint", snapshotting_save)
+    return real_save
+
+
 def _last_loss(out: str) -> float:
     """Last TRAINING loss — eval lines ('step N  eval_loss X') excluded."""
     lines = [
@@ -168,35 +192,21 @@ def test_cli_moe_checkpoint_resume_exact(tmp_path, capsys, monkeypatch):
     runs use --steps 8, so the cosine schedule is identical; a shorter
     head run would sit on a different LR curve and diverge before any
     resume happened."""
-    import shutil
-
     import cs336_systems_tpu.train_cli as cli
 
     moe = ["--experts", "4", "--moe-dispatch", "sorted"]
-
-    def losses(out):
-        return [l.split("loss")[1].split()[0] for l in out.splitlines()
-                if l.startswith("step") and "eval" not in l]
-
     ck = str(tmp_path / "ck")
     ck_mid = str(tmp_path / "ck_mid")
-    real_save = cli.save_checkpoint
-
-    def snapshotting_save(path, *a, **kw):
-        real_save(path, *a, **kw)
-        if kw.get("step") == 4:
-            shutil.copytree(ck, ck_mid, dirs_exist_ok=True)
-
-    monkeypatch.setattr(cli, "save_checkpoint", snapshotting_save)
+    real_save = _snapshot_at_step(cli, monkeypatch, ck, ck_mid, step=4)
     main(TINY + moe + ["--steps", "8", "--log-every", "1",
                        "--checkpoint-dir", ck, "--checkpoint-every", "4"])
-    unbroken = losses(capsys.readouterr().out)
+    unbroken = _step_losses(capsys.readouterr().out)
     monkeypatch.setattr(cli, "save_checkpoint", real_save)
 
     main(TINY + moe + ["--steps", "8", "--log-every", "1",
                        "--checkpoint-dir", ck_mid, "--checkpoint-every", "100",
                        "--resume"])
-    tail = losses(capsys.readouterr().out)
+    tail = _step_losses(capsys.readouterr().out)
     assert tail == unbroken[4:]  # string-exact, digit for digit
 
 
@@ -293,32 +303,17 @@ def test_cli_tp_sp_mode_trains(capsys):
 def test_cli_tp_sp_checkpoint_resume_exact(tmp_path, capsys, monkeypatch):
     """The 3-axis tp_sp mode checkpoints and resumes EXACTLY: losses of
     the resumed tail equal the uninterrupted run digit for digit (params
-    and opt state re-placed onto the tp layout; step-keyed data stream).
-    Uses the mid-run checkpoint snapshot move of the MoE resume test —
-    both runs share --steps so the cosine schedule is identical."""
-    import shutil
-
+    and opt state re-placed onto the tp layout; step-keyed data stream;
+    the shared mid-run snapshot pattern keeps the cosine schedule equal)."""
     import cs336_systems_tpu.train_cli as cli
 
     mode = ["--parallel", "tp_sp", "--mesh", "dp=2,tp=2,sp=2"]
-
-    def losses(out):
-        return [l.split("loss")[1].split()[0] for l in out.splitlines()
-                if l.startswith("step") and "eval" not in l]
-
     ck = str(tmp_path / "ck")
     ck_mid = str(tmp_path / "ck_mid")
-    real_save = cli.save_checkpoint
-
-    def snapshotting_save(path, *a, **kw):
-        real_save(path, *a, **kw)
-        if kw.get("step") == 4:
-            shutil.copytree(ck, ck_mid, dirs_exist_ok=True)
-
-    monkeypatch.setattr(cli, "save_checkpoint", snapshotting_save)
+    real_save = _snapshot_at_step(cli, monkeypatch, ck, ck_mid, step=4)
     main(TINY + mode + ["--steps", "6", "--log-every", "1",
                         "--checkpoint-dir", ck, "--checkpoint-every", "2"])
-    unbroken = losses(capsys.readouterr().out)
+    unbroken = _step_losses(capsys.readouterr().out)
     monkeypatch.setattr(cli, "save_checkpoint", real_save)
 
     main(TINY + mode + ["--steps", "6", "--log-every", "1",
@@ -326,4 +321,4 @@ def test_cli_tp_sp_checkpoint_resume_exact(tmp_path, capsys, monkeypatch):
                         "--checkpoint-every", "100", "--resume"])
     out = capsys.readouterr().out
     assert "resumed" in out
-    assert losses(out) == unbroken[4:]  # string-exact, digit for digit
+    assert _step_losses(out) == unbroken[4:]  # string-exact
