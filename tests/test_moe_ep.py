@@ -566,3 +566,26 @@ def test_gmm_w13_fused_matches_unfused_chain():
     for a, b, name in zip(gf, gu, ("dx", "dw1", "dw3")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_ep_a2a_uneven_split_direction():
+    """{dp:4, ep:2} — more dp than ep (the transpose of the main oracle
+    mesh): two local experts per shard, fill order over 8 token shards."""
+    from cs336_systems_tpu.parallel.mesh import shard_batch
+
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="sorted")
+    mesh = make_mesh({"dp": 4, "ep": 2})
+    hp = AdamWHparams(lr=1e-3)
+    x = jax.random.randint(jax.random.PRNGKey(5), (8, 32), 0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    ref = make_train_step(cfg, hp, donate=False)
+    p_ref, _, l_ref = ref(params, opt, x, y)
+
+    p_ep = shard_params_ep(params, mesh, cfg)
+    o_ep = adamw_init(p_ep)
+    step = make_ep_train_step(cfg, hp, mesh, donate=False)
+    xs, ys = shard_batch(mesh, x, y, axis=("dp", "ep"))
+    p_ep, _, l_ep = step(p_ep, o_ep, xs, ys)
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    assert trees_allclose(p_ep, p_ref, rtol=1e-4, atol=1e-5)
