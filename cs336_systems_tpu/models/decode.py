@@ -205,7 +205,12 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
         attn_out = jax.lax.psum(attn_out, reduce_axis)
     x = x + attn_out
     ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
-    if reduce_axis is not None:
+    # The tp reduce applies to the DENSE SwiGLU's row-parallel w2
+    # partial sums only: under MoE serving the expert weights are never
+    # tp-sharded (replicated, or ep-sharded with _ffn psumming over ep
+    # internally), so the ffn output is already tp-replicated and a tp
+    # psum here would multiply it by the tp degree.
+    if reduce_axis is not None and cfg.num_experts == 0:
         ffn_out = jax.lax.psum(ffn_out, reduce_axis)
     x = x + ffn_out
     return x, kv
@@ -363,7 +368,9 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
             attn_out = jax.lax.psum(attn_out, reduce_axis)
         x = x + attn_out
         ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
-        if reduce_axis is not None:
+        # same tp/ep reduce split as _decode_block: MoE ffn output is
+        # never tp-sharded (ep-psum'd internally or replicated)
+        if reduce_axis is not None and cfg.num_experts == 0:
             ffn_out = jax.lax.psum(ffn_out, reduce_axis)
         x = x + ffn_out
         return x, (k, v)

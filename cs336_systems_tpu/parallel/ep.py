@@ -60,10 +60,11 @@ def validate_ep(cfg: TransformerConfig, mesh: Mesh, axis: str = "ep") -> None:
         )
 
 
-def param_specs(cfg: TransformerConfig, axis: str = "ep"):
+def param_specs(cfg: TransformerConfig, axis: str | None = "ep"):
     """Expert leaves sharded on the expert dim (blocks stacked on a leading
     layer axis → expert weights are rank-4 [L, E, d_out, d_in]); router and
-    every dense leaf replicated."""
+    every dense leaf replicated. ``axis=None`` gives the fully replicated
+    MoE tree (the serving tp+MoE layout's base)."""
     rep2 = P(None, None)
     rep3 = P(None, None, None)
     expert = P(None, axis, None, None)

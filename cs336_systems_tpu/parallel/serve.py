@@ -10,8 +10,9 @@ mesh), keeping the Pallas decode kernel's aliased in-place cache intact —
 each shard's cache leaves live in ITS memory and are updated by ITS
 kernel calls; no cache row ever crosses the interconnect.
 
-Three axes (dp/tp for dense models, dp/ep for MoE), composable in one
-mesh:
+Three axes, composable in one mesh (dp + tp for dense models; dp + ep
+for MoE, with tp optionally sharding the MoE model's attention too —
+round 5):
 
 - ``dp`` (batch sharding): decode is embarrassingly parallel over rows —
   params and the PRNG key replicate, prompts/caches/outputs shard, and
@@ -34,7 +35,10 @@ mesh:
   HBM), tokens replicate over ep, and each shard computes its own
   experts' claims with ONE psum per MoE layer
   (models/moe.moe_ffn_ep_local); bit-identical to the single-device
-  dropless path at top_k ≤ 2.
+  dropless path at top_k ≤ 2. COMPOSES with tp (round 5): attention
+  projections + KV caches shard over tp while experts shard over ep —
+  the ffn tp-psum is skipped for MoE (the expert output is
+  tp-replicated; models/decode._decode_block).
 
 Ragged batches are first-class: pass ``prompt_lens`` ([B] per-row prompt
 lengths, rows left-aligned in the padded buffer) and every row decodes
@@ -61,15 +65,27 @@ def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None,
     embedding + lm_head + norms replicated. With ``ep_axis`` (MoE
     serving) the expert weights shard on their expert dim and everything
     else replicates. All-replicated when both are None."""
-    if ep_axis is not None:
-        if tp_axis is not None:
-            raise ValueError("tp+ep serving is not composed yet")
-        # the training ep layout IS the serving layout (expert leaves
-        # over ep, everything else replicated) — delegate like the tp
-        # branch does, so the param-tree structure lives in ONE place
+    if ep_axis is not None and cfg.num_experts <= 0:
+        raise ValueError(
+            "ep_axis shards MoE expert weights; the config has "
+            "num_experts=0 — a dense layout would silently drop it"
+        )
+    if cfg.num_experts > 0 and (tp_axis is not None or ep_axis is not None):
+        # MoE layout: expert leaves over ep (or replicated), attention
+        # projections Megatron-sharded over tp when given — the ep tree
+        # is the base and the tp attention entries come from tp.param_specs
+        # so each layout lives in ONE place
         from cs336_systems_tpu.parallel.ep import param_specs
 
-        return param_specs(cfg, ep_axis)
+        specs = param_specs(cfg, ep_axis)
+        if tp_axis is not None:
+            from cs336_systems_tpu.parallel.tp import (
+                param_specs as tp_param_specs,
+            )
+
+            specs["blocks"]["attn"] = tp_param_specs(
+                cfg, tp_axis)["blocks"]["attn"]
+        return specs
     if tp_axis is None:
         return P()
     from cs336_systems_tpu.parallel.tp import param_specs
@@ -104,9 +120,10 @@ def make_sharded_generate(
     ``ep_axis`` (MoE only): mesh axis the EXPERT weights shard over —
     tokens replicate over it and each shard computes its own experts'
     claims, one psum per MoE layer (models/moe.moe_ffn_ep_local); the
-    path for expert weights beyond one chip's HBM. Composes with dp
-    ({dp: b, ep: e} meshes); tp+MoE stays excluded. Tokens come back
-    fully replicated on tp/ep and batch-sharded on dp.
+    path for expert weights beyond one chip's HBM. Composes with dp AND
+    tp ({dp, tp, ep} meshes — attention shards over tp, experts over
+    ep; tp-alone MoE replicates the experts). Tokens come back fully
+    replicated on tp/ep and batch-sharded on dp.
 
     Equivalence to the single-device row-keyed path
     (``generate_kv_batched(..., row_keyed=True)``): the dp axis is
@@ -140,11 +157,6 @@ def make_sharded_generate(
         if cfg.num_experts <= 0:
             raise ValueError("ep_axis shards MoE expert weights; the "
                              "config has num_experts=0")
-        if tp_axis is not None:
-            raise ValueError(
-                "tp+ep serving is not composed yet: tp shards the dense "
-                "block weights, which an MoE config does not have"
-            )
         if cfg.num_experts % mesh.shape[ep_axis]:
             raise ValueError(
                 f"num_experts={cfg.num_experts} not divisible by "
@@ -155,21 +167,22 @@ def make_sharded_generate(
         cfg = dataclasses.replace(cfg, moe_dispatch="sorted",
                                   moe_ep_axis=ep_axis)
     if tp_axis is not None:
-        if cfg.num_experts > 0:
-            raise ValueError(
-                "tp serving shards the dense block weights; MoE serving "
-                "shards over dp and/or ep (expert weights are not in the "
-                "tp spec)"
-            )
         # Only the dims the serving spec actually shards need dividing:
-        # heads (q/k/v column weights + cache) and d_ff (w1/w3/w2). The
-        # lm_head is REPLICATED here, so training-tp's vocab check does
-        # not apply.
+        # heads (q/k/v column weights + cache) always; d_ff (w1/w3/w2)
+        # only for the DENSE model — MoE expert weights are never
+        # tp-sharded (replicated or over ep; models/decode skips the
+        # ffn tp-psum accordingly). The lm_head is REPLICATED here, so
+        # training-tp's vocab check does not apply.
         tp = mesh.shape[tp_axis]
-        if cfg.num_heads % tp or cfg.d_ff % tp:
+        if cfg.num_heads % tp:
             raise ValueError(
-                f"num_heads={cfg.num_heads} and d_ff={cfg.d_ff} must both "
-                f"divide by {tp_axis}={tp} for head-sharded serving"
+                f"num_heads={cfg.num_heads} must divide by "
+                f"{tp_axis}={tp} for head-sharded serving"
+            )
+        if cfg.num_experts == 0 and cfg.d_ff % tp:
+            raise ValueError(
+                f"d_ff={cfg.d_ff} must divide by {tp_axis}={tp} for "
+                "ff-sharded dense serving"
             )
 
     from cs336_systems_tpu.models.decode import _generate_scan

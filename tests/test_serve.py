@@ -113,11 +113,15 @@ def test_serve_validation():
     params, prompts, key = _setup(batch=6)
     with pytest.raises(ValueError, match="divisible"):
         gen(params, prompts, key)
-    with pytest.raises(ValueError, match="MoE serving"):
+    # tp+MoE is SUPPORTED since round 5 (attention over tp, experts
+    # replicated or over ep — test_sharded_generate_moe_tp_ep_composed);
+    # what must still raise is a head count the tp degree cannot divide:
+    with pytest.raises(ValueError, match="num_heads"):
         make_sharded_generate(
-            dataclasses.replace(CFG, num_experts=4),
+            dataclasses.replace(CFG, num_heads=2, d_model=32,
+                                num_experts=4),
             make_mesh({"dp": 2, "tp": 4}),
-            max_new_tokens=8, tp_axis="tp",
+            max_new_tokens=8, tp_axis="tp",  # heads 2 % tp 4 != 0
         )
 
 
@@ -201,10 +205,35 @@ def test_ep_serving_validation():
     with pytest.raises(ValueError, match="not divisible"):
         make_sharded_generate(moe, mesh, max_new_tokens=4, ep_axis="ep")
     moe8 = dataclasses.replace(CFG, num_experts=8, moe_top_k=2)
-    mesh2 = make_mesh({"tp": 2, "ep": 4})
-    with pytest.raises(ValueError, match="tp\\+ep"):
-        make_sharded_generate(moe8, mesh2, max_new_tokens=4, dp_axis=None,
-                              ep_axis="ep", tp_axis="tp")
     with pytest.raises(ValueError, match="distinct"):
         make_sharded_generate(moe8, mesh, max_new_tokens=4, dp_axis="ep",
                               ep_axis="ep")
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"tp": 2, "ep": 4}, None, "tp"),
+    ({"dp": 2, "tp": 2, "ep": 2}, "dp", "tp"),
+    ({"tp": 4}, None, "tp"),  # tp-alone MoE: attention sharded, experts replicated
+])
+def test_sharded_generate_moe_tp_ep_composed(mesh_axes, dp, tp):
+    """MoE serving composed with head sharding (round 5): attention
+    projections + KV caches shard over tp, expert weights over ep (or
+    replicate), batch over dp — the former tp+MoE exclusion is gone. The
+    ffn tp-psum is skipped for MoE (the expert output is tp-replicated;
+    models/decode._decode_block), which this test would catch as a
+    tp-degree multiplication if wrong. Token equality vs the
+    single-device row-keyed path at the tested configs (tp psums can
+    perturb logit low bits; same empirical contract as dense tp)."""
+    cfg = dataclasses.replace(CFG, num_experts=8, moe_top_k=2)
+    params, prompts, key = _setup(cfg)
+    want = np.asarray(generate_kv_batched(
+        params, cfg, prompts, 8, key, temperature=0.9, top_k=8,
+        row_keyed=True,
+    ))
+    mesh = make_mesh(mesh_axes)
+    tp_kw = {"tp_axis": tp} if tp else {}
+    ep_kw = {"ep_axis": "ep"} if "ep" in mesh_axes else {}
+    gen = make_sharded_generate(cfg, mesh, max_new_tokens=8, dp_axis=dp,
+                                temperature=0.9, top_k=8, **tp_kw, **ep_kw)
+    got = np.asarray(gen(params, prompts, key))
+    np.testing.assert_array_equal(got, want)
