@@ -41,7 +41,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.layers import embedding, linear, rmsnorm, rope_cache
 from cs336_systems_tpu.models.transformer import TransformerConfig, _block
