@@ -4,8 +4,13 @@ A second model family beyond the reference's dense Transformer (the
 reference has no MoE anywhere — this is part of the complete framework
 surface, and the substrate for expert parallelism in ``parallel/ep.py``).
 
-Two dispatch schemes, same routing semantics (GShard priority fill:
-top-1 claims take capacity before top-2, token order within a priority):
+Dispatch schemes, same routing semantics (GShard priority fill: top-1
+claims take capacity before top-2, token order within a priority) —
+"dense" and "sorted" below, plus "gmm" (dropless Pallas grouped matmul
+with the fused gate/up+silu·mul kernel, ops/grouped_matmul.py), the
+expert-parallel all-to-all form (``_moe_ffn_ep_a2a``, parallel/ep.py's
+default step), and the expert-sharded serving form
+(``moe_ffn_ep_local``, parallel/serve.py):
 
 - ``"dense"`` — GShard/Mesh-TensorFlow one-hot dispatch/combine tensors
   [T, E, C] (T tokens, E experts, C capacity slots); the layer is three
