@@ -16,4 +16,6 @@ the MXU (large batched matmuls, bf16 compute / fp32 accumulate) and the ICI
 (sharding via ``jax.sharding.Mesh`` + ``shard_map``).
 """
 
+from cs336_systems_tpu import _compat  # noqa: F401  (installs jax API shims)
+
 __version__ = "0.1.0"

@@ -1,0 +1,120 @@
+"""Compatibility shims for older jax releases (0.4.x).
+
+The codebase is written against the current public API — ``jax.shard_map``,
+``jax.lax.pcast``, ``jax.lax.axis_size`` — which jax 0.4.x does not export
+(shard_map lives in ``jax.experimental.shard_map`` and has the older
+``check_rep`` keyword instead of ``check_vma``; pcast/axis_size do not
+exist). Importing the package installs forwarding shims onto the ``jax``
+module when (and only when) the names are missing, so every call site —
+including ``from jax import shard_map`` module-level imports — works
+unchanged on both API generations.
+
+Semantics note: the shimmed ``shard_map`` forces ``check_rep=False``. The
+manual-collective step builders here rely on the new-jax varying-axis
+semantics — gradients of replicated operands are LOCAL and the code issues
+its own psums (parallel/dp.local_value_and_grad documents why). On 0.4.x
+that is exactly the ``check_rep=False`` behaviour; ``check_rep=True`` both
+auto-inserts cotangent psums the code does not want and rejects regions
+(Pallas custom calls, all_to_all) its replication checker cannot type.
+Correspondingly ``pcast(x, axis, to="varying")`` — a pure type-level cast in
+new jax — is the identity here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                      check_rep=None, auto=frozenset()):
+            del check_vma, check_rep  # see module docstring
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False, auto=auto)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "pcast"):
+
+        def pcast(x, axis_name, *, to):
+            del axis_name, to  # identity under check_rep=False (docstring)
+            return x
+
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax._src.core import axis_frame  # returns the size (an int)
+
+        def axis_size(axis_name):
+            if isinstance(axis_name, (tuple, list)):
+                n = 1
+                for a in axis_name:
+                    n *= axis_frame(a)
+                return n
+            return axis_frame(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax, "typeof"):
+        # jax.typeof(x) returns the aval; the only consumer attribute the
+        # codebase reads that 0.4.x avals lack is ``vma`` (varying manual
+        # axes — a type system this jax does not have), so proxy it as
+        # empty: nothing is vma-varying when vma does not exist.
+        from jax._src.core import get_aval as _get_aval
+
+        class _AvalProxy:
+            __slots__ = ("_aval",)
+
+            def __init__(self, aval):
+                self._aval = aval
+
+            def __getattr__(self, name):
+                if name == "vma":
+                    return getattr(self._aval, "vma", frozenset())
+                return getattr(self._aval, name)
+
+        jax.typeof = lambda x: _AvalProxy(_get_aval(x))
+
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    except TypeError:
+        _SDS = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_SDS):
+            """0.4.x ShapeDtypeStruct has no ``vma`` keyword; accept and
+            drop it (no vma type system to thread it into)."""
+
+            def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+                del vma
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    # 0.4.x has jax.lax.optimization_barrier but no AD rules for it, so
+    # any differentiated fn containing a barrier (the unrolled MoE stack
+    # in models/transformer.py) fails to trace. Current jax passes
+    # gradients through a barrier of their own: tangents and cotangents
+    # are barrier'd too, keeping the backward's per-layer structure
+    # pinned the same way as the forward's.
+    from jax._src.lax import lax as _lax_internal
+    from jax.interpreters import ad as _ad
+
+    _ob_p = getattr(_lax_internal, "optimization_barrier_p", None)
+    if _ob_p is not None and _ob_p not in _ad.primitive_jvps:
+
+        def _ob_jvp(primals, tangents):
+            tangents = [_ad.instantiate_zeros(t) for t in tangents]
+            return _ob_p.bind(*primals), _ob_p.bind(*tangents)
+
+        def _ob_transpose(cts, *primals):
+            cts = [_ad.instantiate_zeros(ct) for ct in cts]
+            return _ob_p.bind(*cts)
+
+        _ad.primitive_jvps[_ob_p] = _ob_jvp
+        _ad.primitive_transposes[_ob_p] = _ob_transpose
+
+
+_install()

@@ -1,0 +1,33 @@
+"""graft-lint: static jaxpr/HLO contract checking for the repo's
+hard-won performance invariants.
+
+Five rounds of on-chip work produced invariants that otherwise live only
+as prose in BASELINE.md — collective counts per sharding family, buffer
+donation discipline, the ``_prefix_count``-not-``lax.cumsum`` routing
+rule, per-layer ``optimization_barrier``s in unrolled MoE stacks, and the
+Pallas VMEM/tile caps. This package makes them machine-checked on plain
+CPU: every registered step function is traced with abstract shapes on the
+8-virtual-device CPU mesh (the same trick ``benchmarks/memory --mode
+analyze`` uses — no device memory, no TPU), its closed jaxpr walked, and
+its lowering inspected.
+
+Modules:
+
+- ``jaxpr_scan``  — recursive jaxpr walking: collective counting,
+                    primitive search, abstract tracing helpers.
+- ``contracts``   — the checks: collective contracts, donation/aliasing,
+                    TPU anti-pattern lints (big cumsum/reduce_window,
+                    missing MoE barriers, fp32 dots on bf16 paths).
+- ``vmem``        — static Pallas VMEM estimates vs the 16 MB scoped
+                    limit, plus the chip-established caps and the Mosaic
+                    crash matrix as data.
+- ``registry``    — the registered step functions with their declared
+                    contracts (each ``parallel/*`` family declares its own
+                    via ``lint_contract``).
+- ``lint``        — the CLI: ``python -m cs336_systems_tpu.analysis.lint``
+                    (human report, ``--json``, nonzero exit on violation).
+
+Rule catalog and usage: ``cs336_systems_tpu/analysis/README.md``.
+"""
+
+from cs336_systems_tpu.analysis.contracts import Violation  # noqa: F401

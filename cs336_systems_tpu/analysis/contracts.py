@@ -1,0 +1,154 @@
+"""The graft-lint checks: each takes a traced/lowered artifact plus the
+declared expectation and returns a list of ``Violation``s (empty = clean).
+
+Every rule here encodes a regression the chip already taught us
+(BASELINE.md / the round logs):
+
+- ``collective-contract`` — a sharding family silently gaining or losing
+  collectives (e.g. dp serving must issue ZERO; ep-a2a training exactly
+  its documented all_to_all budget).
+- ``donation`` — ``donate_argnums`` that stops producing input/output
+  aliasing leaves multi-GB param/moment buffers live across the step
+  (the undonated-buffer pileup in CLAUDE.md's measurement notes).
+- ``routing-cumsum`` — ``lax.cumsum``/``reduce_window`` on long axes:
+  2.1 ms per [16384, 8] call on TPU (reduce-window lowering);
+  routing must use ``models/moe._prefix_count``.
+- ``moe-barrier`` — unrolled MoE layer loops need the per-layer
+  ``optimization_barrier`` or XLA CSEs the per-layer weight casts into a
+  whole-stack convert it then remats every layer: 47.9 ms/step.
+- ``fp32-big-dot`` — a large matmul with BOTH operands fp32 on a
+  bf16-compute path is a silent 2× MXU-throughput loss; accumulation
+  belongs in ``preferred_element_type``, not upcast operands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from cs336_systems_tpu.analysis import jaxpr_scan
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    where: str  # registered step / kernel-config name
+    message: str
+
+    def to_dict(self) -> dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+def check_collectives(name: str, jaxpr, expected: dict[str, int],
+                      note: str = "") -> list[Violation]:
+    """Exact static call-site counts for the five collective classes.
+    ``expected`` maps primitive name -> count; omitted classes must be 0."""
+    counts = jaxpr_scan.count_collectives(jaxpr)
+    out = []
+    for prim in jaxpr_scan.COLLECTIVE_PRIMS:
+        want = expected.get(prim, 0)
+        got = counts[prim]
+        if got != want:
+            hint = f" (contract: {note})" if note else ""
+            out.append(Violation(
+                "collective-contract", name,
+                f"{prim}: {got} issued, contract says {want}{hint}",
+            ))
+    return out
+
+
+def check_donation(name: str, stablehlo: str, min_aliases: int) -> list[Violation]:
+    """The lowering must alias >= ``min_aliases`` donated inputs to
+    outputs (normally the params + optimizer-state leaf count)."""
+    got = jaxpr_scan.count_aliased_args(stablehlo)
+    if got < min_aliases:
+        return [Violation(
+            "donation", name,
+            f"only {got} input buffers aliased to outputs, expected >= "
+            f"{min_aliases} — donate_argnums is not taking effect; "
+            "undonated params/moments double the step's high-water mark",
+        )]
+    return []
+
+
+# Below this many scanned elements a cumsum is harmless (tile_maps' [E+1]
+# expert cumsum, tiny host-side bookkeeping); at and above it the TPU
+# reduce-window lowering is the measured 2.1 ms / [16384, 8] disaster.
+CUMSUM_AXIS_THRESHOLD = 1024
+
+_SCAN_PRIMS = ("cumsum", "cumprod", "cummax", "cummin",
+               "reduce_window_sum", "reduce_window")
+
+
+def check_no_big_cumsum(name: str, jaxpr,
+                        threshold: int = CUMSUM_AXIS_THRESHOLD) -> list[Violation]:
+    out = []
+    for eqn in jaxpr_scan.iter_eqns(jaxpr):
+        pname = eqn.primitive.name
+        if pname not in _SCAN_PRIMS:
+            continue
+        shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+        axis = eqn.params.get("axis")
+        length = shape[axis] if (axis is not None and shape) else max(shape or (0,))
+        if length >= threshold:
+            out.append(Violation(
+                "routing-cumsum", name,
+                f"{pname} over axis length {length} (operand {shape}) — "
+                "lowers to an O(T·window) reduce_window on TPU (2.1 ms per "
+                "[16384, 8] call); use models/moe._prefix_count",
+            ))
+    return out
+
+
+def check_barriers(name: str, jaxpr, expected: int) -> list[Violation]:
+    """Unrolled MoE stacks must carry >= one optimization_barrier per
+    layer (transformer.py pins the per-layer param slice) or XLA CSEs the
+    per-layer weight casts into one whole-stack convert and remats it at
+    every layer (measured 47.9 ms/step)."""
+    got = jaxpr_scan.count_prim(jaxpr, "optimization_barrier")
+    if got < expected:
+        return [Violation(
+            "moe-barrier", name,
+            f"{got} optimization_barrier eqns, expected >= {expected} "
+            "(one per unrolled MoE layer — models/transformer.py); "
+            "missing barriers cost 47.9 ms/step in whole-stack cast remat",
+        )]
+    return []
+
+
+# A dot is "big" when M, N and K are ALL at least this: the fp32 router
+# matmul ([T, D] x [D, E], E ~ 8) and the tril prefix-sum einsums pass
+# under it by design; a silently-upcast projection/FFN/attention matmul
+# (every dim >= 256 at any real size) does not.
+FP32_DOT_MIN_DIM = 256
+
+
+def _dot_mnk(eqn) -> tuple[int, int, int]:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    ls = eqn.invars[0].aval.shape
+    rs = eqn.invars[1].aval.shape
+    m = math.prod(d for i, d in enumerate(ls) if i not in lc and i not in lb)
+    n = math.prod(d for i, d in enumerate(rs) if i not in rc and i not in rb)
+    k = math.prod(ls[i] for i in lc)
+    return m, n, k
+
+
+def check_no_big_fp32_dots(name: str, jaxpr,
+                           min_dim: int = FP32_DOT_MIN_DIM) -> list[Violation]:
+    out = []
+    for eqn in jaxpr_scan.iter_eqns(jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+        if str(lhs.dtype) != "float32" or str(rhs.dtype) != "float32":
+            continue
+        m, n, k = _dot_mnk(eqn)
+        if min(m, n, k) >= min_dim:
+            out.append(Violation(
+                "fp32-big-dot", name,
+                f"fp32 x fp32 dot_general with M,N,K = {m},{n},{k} "
+                f"(operands {tuple(lhs.shape)} . {tuple(rhs.shape)}) on a "
+                "bf16 compute path — cast operands to the compute dtype "
+                "and accumulate via preferred_element_type instead",
+            ))
+    return out

@@ -1,0 +1,103 @@
+"""Recursive jaxpr walking and abstract tracing — the substrate every
+graft-lint check stands on.
+
+Steps are traced with ABSTRACT shapes only (``jax.ShapeDtypeStruct`` /
+``jax.eval_shape``), the same zero-device-memory trick
+``benchmarks/memory.analyze_memory_cell`` uses, so the whole linter runs
+on the hermetic 8-virtual-device CPU mesh (tests/conftest.py's
+environment) in seconds per step. Collectives issued by ``shard_map``
+regions appear as first-class primitives in the closed jaxpr (``psum``,
+``all_gather``, ``all_to_all``, ``ppermute``, ``reduce_scatter``) and are
+counted by static call site — a ``lax.scan`` body counts once, an
+unrolled layer loop counts per layer, which is exactly the granularity
+the documented contracts are written at. GSPMD-annotated steps
+(``parallel/tp.py``-style ``in_shardings`` jits) carry no collectives in
+their jaxpr — XLA inserts them at compile time — so their registry
+entries declare donation/lint checks only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+
+# Primitive names that move data across mesh axes, as they appear in
+# closed jaxprs (jax.lax.pmean traces to psum + div, so pmeans are counted
+# as psums; jax.lax.psum_scatter traces to reduce_scatter).
+COLLECTIVE_PRIMS = (
+    "psum", "all_gather", "all_to_all", "ppermute", "reduce_scatter",
+)
+
+
+def _sub_jaxprs(params: dict) -> Iterator[Any]:
+    """Yield every jaxpr-valued entry of an eqn's params — pjit/shard_map
+    bodies (``jaxpr``), custom-vjp call jaxprs, scan/while bodies, cond
+    ``branches`` tuples, remat/checkpoint bodies, Pallas kernel jaxprs."""
+    for v in params.values():
+        if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for vv in v:
+                if hasattr(vv, "eqns") or hasattr(vv, "jaxpr"):
+                    yield vv
+
+
+def iter_eqns(jaxpr) -> Iterator[Any]:
+    """Depth-first over every eqn of ``jaxpr`` (a ``Jaxpr`` or
+    ``ClosedJaxpr``) including all nested sub-jaxprs. Each call SITE is
+    visited once — shared sub-jaxpr objects referenced from two eqns are
+    walked per reference, matching issued-op counting."""
+    core = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in core.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def count_collectives(jaxpr) -> dict[str, int]:
+    """Static call-site counts of the five collective classes."""
+    counts = dict.fromkeys(COLLECTIVE_PRIMS, 0)
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in counts:
+            counts[name] += 1
+    return counts
+
+
+def find_eqns(jaxpr, pred: Callable[[Any], bool]) -> list[Any]:
+    return [eqn for eqn in iter_eqns(jaxpr) if pred(eqn)]
+
+
+def count_prim(jaxpr, name: str) -> int:
+    return sum(1 for eqn in iter_eqns(jaxpr) if eqn.primitive.name == name)
+
+
+def trace(fn: Callable, *abstract_args, **kw) -> Any:
+    """Closed jaxpr of ``fn`` applied to abstract arguments (pytrees of
+    ``jax.ShapeDtypeStruct`` are accepted directly)."""
+    return jax.make_jaxpr(fn)(*abstract_args, **kw)
+
+
+def abstract_params(init_fn: Callable, *args) -> Any:
+    """Shape-level evaluation of an initializer — no arrays materialize."""
+    return jax.eval_shape(init_fn, *args)
+
+
+def lowered_text(jit_fn, *abstract_args) -> str:
+    """StableHLO text of the jitted fn lowered over abstract args. Carries
+    the donation decisions as ``tf.aliasing_output`` arg attributes —
+    computed at lowering, so no compile (and no backend donation support)
+    is needed to check them."""
+    return jit_fn.lower(*abstract_args).as_text()
+
+
+def count_aliased_args(stablehlo: str) -> int:
+    """Number of input buffers the lowering marked donated. Two spellings:
+    ``tf.aliasing_output`` when the exact input→output pairing is resolved
+    at lowering (jits with explicit shardings, single-device jits), and
+    ``jax.buffer_donor`` when the pairing is left to the compiler (jits of
+    ``shard_map`` with unspecified out_shardings). Either way the buffer
+    is reusable — both count."""
+    return (stablehlo.count("tf.aliasing_output")
+            + stablehlo.count("jax.buffer_donor"))

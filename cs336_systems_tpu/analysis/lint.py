@@ -1,0 +1,137 @@
+"""graft-lint CLI: statically check the repo's performance contracts.
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m cs336_systems_tpu.analysis.lint [--json] [--only SUBSTR]
+
+Traces every registered step function (analysis/registry.py) with abstract
+shapes on the 8-virtual-device CPU mesh and enforces each family's
+declared ``lint_contract()`` plus the global TPU anti-pattern lints and
+the Pallas VMEM budget facts (analysis/vmem.py). Exit status: 0 clean,
+1 violations, 2 a step failed to build/trace (also a finding — a
+registered step that no longer traces is broken).
+
+No TPU, no device memory: safe to run anywhere the tests run.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes; like
+# tests/conftest.py, also win over a site plugin that pre-imported jax.
+if not os.environ.get("CS336_TPU_LINT"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+if not os.environ.get("CS336_TPU_LINT"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu.analysis import contracts, registry, vmem
+from cs336_systems_tpu.analysis.contracts import Violation
+
+
+def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
+    """All applicable checks for one traced step + its declared contract."""
+    c = traced.contract
+    out: list[Violation] = []
+    expected = c.get("collectives")
+    if expected is not None:
+        out += contracts.check_collectives(name, traced.jaxpr, expected,
+                                           note=c.get("note", ""))
+    if c.get("min_aliases", 0) and traced.stablehlo is not None:
+        out += contracts.check_donation(name, traced.stablehlo,
+                                        c["min_aliases"])
+    if c.get("barriers", 0):
+        out += contracts.check_barriers(name, traced.jaxpr, c["barriers"])
+    out += contracts.check_no_big_cumsum(name, traced.jaxpr)
+    if c.get("check_fp32_dots"):
+        out += contracts.check_no_big_fp32_dots(name, traced.jaxpr)
+    return out
+
+
+def run(only: str | None = None):
+    """(results, violations, errors): per-step outcomes for reporting."""
+    results = []  # (name, seconds, n_violations)
+    violations: list[Violation] = []
+    errors: list[Violation] = []
+    for spec in registry.STEPS:
+        if only and only not in spec.name:
+            continue
+        t0 = time.monotonic()
+        try:
+            traced = spec.build()
+            vs = lint_step(spec.name, traced)
+        except Exception as e:  # noqa: BLE001 — a broken step is a finding
+            errors.append(Violation(
+                "build-error", spec.name,
+                f"step failed to build/trace: {type(e).__name__}: {e}"))
+            results.append((spec.name, time.monotonic() - t0, -1))
+            continue
+        violations += vs
+        results.append((spec.name, time.monotonic() - t0, len(vs)))
+    if only is None or only in "vmem":
+        t0 = time.monotonic()
+        vs = vmem.run_vmem_checks()
+        violations += vs
+        results.append(("vmem", time.monotonic() - t0, len(vs)))
+    return results, violations, errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cs336_systems_tpu.analysis.lint",
+        description="static jaxpr/HLO contract checks (see analysis/README.md)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--only", metavar="SUBSTR",
+                    help="run only steps whose name contains SUBSTR "
+                         "('vmem' selects the VMEM facts)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered steps and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for spec in registry.STEPS:
+            print(spec.name)
+        print("vmem")
+        return 0
+
+    results, violations, errors = run(args.only)
+    all_findings = violations + errors
+
+    if args.json:
+        print(json.dumps({
+            "violations": [v.to_dict() for v in all_findings],
+            "steps": [
+                {"name": n, "seconds": round(s, 2), "violations": k}
+                for n, s, k in results
+            ],
+            "clean": not all_findings,
+        }, indent=2))
+    else:
+        for n, s, k in results:
+            status = ("ERROR" if k < 0
+                      else "ok" if k == 0 else f"{k} violation(s)")
+            print(f"  {n:<20} {s:6.1f}s  {status}")
+        for v in all_findings:
+            print(f"\n[{v.rule}] {v.where}\n  {v.message}")
+        total = sum(max(k, 0) for _, _, k in results)
+        print(f"\ngraft-lint: {len(results)} targets, {total} violation(s), "
+              f"{len(errors)} error(s)")
+    if errors:
+        return 2
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""The registered step functions graft-lint checks, with their declared
+contracts.
+
+Every entry builds a REAL step function from the same factories training
+and serving use (train.make_train_step, parallel/{dp,tp,tp_sp,ep,serve}),
+traces it with abstract shapes on the 8-virtual-device CPU mesh, and pairs
+the trace with the contract the owning module DECLARES via its
+``lint_contract()`` — the expected collective counts live next to the code
+that issues them, not here. Configs are deliberately tiny (the jaxpr's
+structure, which is all the checks read, does not depend on widths) except
+``train_single_bf16``, whose dims exceed the fp32-big-dot threshold so a
+silent fp32 upcast on the bf16 compute path would actually trip the lint.
+
+Contract key glossary (consumed by ``lint.run``):
+
+- ``collectives``: exact expected count per collective primitive
+  (omitted = 0); ``None`` = skip the check (no declared contract).
+- ``min_aliases``: donation floor — the lowering must mark at least this
+  many input buffers donated (0 = skip; serving steps never donate).
+- ``barriers``: minimum ``optimization_barrier`` count (unrolled MoE).
+- ``check_fp32_dots``: enable the fp32-big-dot lint (only meaningful on
+  bf16-compute configs — fp32 configs are fp32 on purpose).
+- The routing-cumsum lint always runs; no jaxpr here may carry a long
+  cumsum/reduce_window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cs336_systems_tpu.analysis import jaxpr_scan
+
+
+@dataclasses.dataclass(frozen=True)
+class Traced:
+    jaxpr: Any
+    stablehlo: str | None  # None = donation not applicable (no lowering)
+    contract: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSpec:
+    name: str
+    build: Callable[[], Traced]
+
+
+def _tiny_cfg(**kw):
+    from cs336_systems_tpu.models.transformer import TransformerConfig
+
+    base = dict(vocab_size=64, context_length=64, d_model=32,
+                num_layers=2, num_heads=4, d_ff=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _moe_cfg(**kw):
+    base = dict(num_experts=8, moe_top_k=2, moe_dispatch="sorted",
+                scan_layers=False)
+    base.update(kw)
+    return _tiny_cfg(**base)
+
+
+def _abstract_state(cfg):
+    from cs336_systems_tpu.train import init_train_state
+
+    return jax.eval_shape(
+        lambda k: init_train_state(k, cfg), jax.random.PRNGKey(0))
+
+
+def _abstract_params(cfg):
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    return jax.eval_shape(
+        lambda k: init_transformer_lm(k, cfg), jax.random.PRNGKey(0))
+
+
+def _batch(cfg, b=8):
+    x = jax.ShapeDtypeStruct((b, cfg.context_length), jnp.int32)
+    return x, x
+
+
+def _n_leaves(tree) -> int:
+    return len(jax.tree_util.tree_leaves(tree))
+
+
+def _hp():
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+
+    return AdamWHparams()
+
+
+def _traced_train(step, state, x, y, contract) -> Traced:
+    jaxpr = jax.make_jaxpr(step)(*state, x, y)
+    hlo = jaxpr_scan.lowered_text(step, *state, x, y)
+    return Traced(jaxpr, hlo, contract)
+
+
+# --- single-device ----------------------------------------------------------
+
+
+def _build_train_single() -> Traced:
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = _tiny_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    contract = {
+        "collectives": {},
+        "min_aliases": _n_leaves(state),
+        "note": "single-device step: no mesh, no collectives; donation "
+                "must alias every param/moment leaf",
+    }
+    return _traced_train(make_train_step(cfg, _hp()), state, x, y, contract)
+
+
+def _build_train_single_bf16() -> Traced:
+    from cs336_systems_tpu.train import make_train_step
+
+    # Real-ish widths: every projection/FFN/attention dot has M,N,K >= 256
+    # so an operand silently upcast to fp32 lands ABOVE the fp32-big-dot
+    # threshold instead of slipping under it.
+    cfg = _tiny_cfg(vocab_size=512, context_length=256, d_model=256,
+                    num_heads=4, d_ff=512, compute_dtype="bfloat16")
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg, b=4)
+    contract = {
+        "collectives": {},
+        "min_aliases": _n_leaves(state),
+        "check_fp32_dots": True,
+        "note": "bf16 compute path: every big dot must have bf16 operands "
+                "(fp32 accumulation via preferred_element_type only)",
+    }
+    return _traced_train(make_train_step(cfg, _hp()), state, x, y, contract)
+
+
+def _build_train_moe(dispatch: str) -> Traced:
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = _moe_cfg(moe_dispatch=dispatch)
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    contract = {
+        "collectives": {},
+        "min_aliases": _n_leaves(state),
+        "barriers": cfg.num_layers,  # forward floor; bwd adds its own
+        "note": f"single-device MoE[{dispatch}]: unrolled stack needs the "
+                "per-layer optimization_barrier; routing must be "
+                "_prefix_count (no long cumsum)",
+    }
+    return _traced_train(make_train_step(cfg, _hp()), state, x, y, contract)
+
+
+# --- training parallelism families -----------------------------------------
+
+
+def _build_train_dp(variant: str) -> Traced:
+    from cs336_systems_tpu.parallel.dp import lint_contract, make_dp_train_step
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _tiny_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    step = make_dp_train_step(cfg, _hp(), make_mesh({"dp": 8}),
+                              variant=variant)
+    contract = dict(lint_contract(state[0], variant=variant),
+                    min_aliases=_n_leaves(state))
+    return _traced_train(step, state, x, y, contract)
+
+
+def _build_train_tp() -> Traced:
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.tp import lint_contract, make_tp_train_step
+
+    cfg = _tiny_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    step = make_tp_train_step(cfg, _hp(), make_mesh({"dp": 2, "tp": 4}))
+    contract = dict(lint_contract(), min_aliases=_n_leaves(state))
+    return _traced_train(step, state, x, y, contract)
+
+
+def _build_train_tp_sp() -> Traced:
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.tp_sp import (
+        lint_contract, make_tp_sp_train_step)
+
+    cfg = _tiny_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    step = make_tp_sp_train_step(
+        cfg, _hp(), make_mesh({"dp": 2, "tp": 2, "sp": 2}))
+    contract = dict(lint_contract(cfg), min_aliases=_n_leaves(state))
+    return _traced_train(step, state, x, y, contract)
+
+
+def _build_train_ep_a2a() -> Traced:
+    from cs336_systems_tpu.parallel.ep import lint_contract, make_ep_train_step
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _moe_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    step = make_ep_train_step(cfg, _hp(), make_mesh({"dp": 2, "ep": 4}))
+    contract = dict(lint_contract(cfg, n_token_axes=2),
+                    min_aliases=_n_leaves(state))
+    return _traced_train(step, state, x, y, contract)
+
+
+# --- serving ----------------------------------------------------------------
+
+
+def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
+                 ragged=False) -> Traced:
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import (
+        lint_contract, make_sharded_generate)
+
+    cfg = _tiny_cfg() if ep_axis is None else _tiny_cfg(num_experts=8,
+                                                        moe_top_k=2)
+    params = _abstract_params(cfg)
+    ids = jax.ShapeDtypeStruct((8, 6), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    gen = make_sharded_generate(
+        cfg, make_mesh(mesh_axes), max_new_tokens=4, dp_axis=dp_axis,
+        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8)
+    if ragged:
+        # per-row prompt lengths are host-side ints (they pick the shard_map
+        # program and the cache allocation), so close over concrete values
+        lens = np.full((8,), 6, np.int32)
+        lens[:4] = 3
+        fn = lambda p, i, k: gen(p, i, k, prompt_lens=lens)
+    else:
+        fn = gen
+    jaxpr = jax.make_jaxpr(fn)(params, ids, key)
+    contract = lint_contract(cfg, dp_axis=dp_axis, tp_axis=tp_axis,
+                             ep_axis=ep_axis)
+    return Traced(jaxpr, None, contract)
+
+
+STEPS: tuple[StepSpec, ...] = (
+    StepSpec("train_single", _build_train_single),
+    StepSpec("train_single_bf16", _build_train_single_bf16),
+    StepSpec("train_moe_sorted",
+             functools.partial(_build_train_moe, "sorted")),
+    StepSpec("train_moe_gmm", functools.partial(_build_train_moe, "gmm")),
+    StepSpec("train_dp_naive", functools.partial(_build_train_dp, "naive")),
+    StepSpec("train_dp_bucketed",
+             functools.partial(_build_train_dp, "bucketed")),
+    StepSpec("train_tp", _build_train_tp),
+    StepSpec("train_tp_sp", _build_train_tp_sp),
+    StepSpec("train_ep_a2a", _build_train_ep_a2a),
+    StepSpec("serve_dp", functools.partial(_build_serve, {"dp": 8}, "dp")),
+    StepSpec("serve_tp",
+             functools.partial(_build_serve, {"tp": 4}, None, "tp")),
+    StepSpec("serve_ep",
+             functools.partial(_build_serve, {"dp": 2, "ep": 4}, "dp",
+                               None, "ep")),
+    StepSpec("serve_tp_ragged",
+             functools.partial(_build_serve, {"dp": 2, "tp": 4}, "dp",
+                               "tp", None, True)),
+)

@@ -1,0 +1,232 @@
+"""Static Pallas VMEM budget checks: the chip-established compile/crash
+facts as DATA, validated against the ops modules' static estimators.
+
+Each kernel family exports a bytes-from-BlockSpecs estimator
+(``ops/flash_attention.fwd_vmem_bytes`` / ``tiled_bwd_vmem_bytes`` /
+``fused_bwd_vmem_bytes``, ``ops/grouped_matmul.gmm_vmem_bytes``,
+``ops/decode_attention.decode_vmem_bytes``) and the pickers consume the
+same arithmetic, so a tile/group/dtype configuration that would blow the
+16 MB scoped-VMEM limit is rejected at trace time instead of nine minutes
+into a Mosaic compile. This module pins the estimators to reality: every
+pass/fail recorded on v5e (BASELINE.md, the round logs) is re-derived from
+the estimator, and any drift — someone "fixing" a formula until an
+on-chip-failing config looks safe, or a kernel change invalidating a cap
+that is still enforced — shows up as a lint violation on plain CPU.
+
+The caps themselves (as established on chip):
+
+- flash fwd: 1024-tiles compile and win; 2048-tiles fail VMEM.
+- tiled bwd: 512-tile cap under FUSED ROPE (1024-tiles + the 4 fp32
+  table blocks exceed the limit — found by ctx-65536 training);
+  1024-tiles fine without rope.
+- fused single-pass bwd: S <= 1024 bf16 / 512 fp32 (the S×S live set;
+  fp32 at S=1024 is a verified on-chip Mosaic compile failure).
+- Mosaic crash matrix: fp32 × d_head<32 × fwd group G=4 crashes the
+  compiler; g<=2, bf16 g=4, fp32 d>=32 g=4 all compile.
+- grouped matmul: weight blocks stream under the ~5 MB soft budget so
+  the whole grid step double-buffers inside 16 MB.
+- decode: the packed-KV K‖V slab (double-buffered) stays under 8 MB so
+  the attend window + merge tiles fit beside it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from cs336_systems_tpu.analysis.contracts import Violation
+from cs336_systems_tpu.ops import decode_attention as da
+from cs336_systems_tpu.ops import flash_attention as fa
+from cs336_systems_tpu.ops import grouped_matmul as gm
+
+SCOPED_VMEM_LIMIT = 16 * 1024 * 1024
+
+_MB = 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class VmemCheck:
+    name: str
+    holds: Callable[[], bool]
+    chip_fact: str  # the on-chip observation this re-derives
+
+
+def _fits(estimate: int) -> bool:
+    return estimate <= SCOPED_VMEM_LIMIT
+
+
+CHECKS: tuple[VmemCheck, ...] = (
+    # --- flash forward tiles ---------------------------------------------
+    VmemCheck(
+        "flash-fwd-1024-tile-fits",
+        lambda: _fits(fa.fwd_vmem_bytes(1024, 1024, 64, 2, g=1,
+                                        has_rope=True)),
+        "1024-tile fwd (the current default, fused rope) compiles on v5e "
+        "and halved long-S grid-step time (S=65536 fwd 173->79 ms)",
+    ),
+    VmemCheck(
+        "flash-fwd-2048-tile-blows",
+        lambda: not _fits(fa.fwd_vmem_bytes(2048, 2048, 64, 2, g=1,
+                                            has_rope=True)),
+        "2048-tile fwd fails to compile (VMEM) on v5e",
+    ),
+    VmemCheck(
+        "flash-fwd-picker-pinned",
+        lambda: (fa._pick_group(768, 512, 512, 64, 2, True) == 3
+                 and fa._pick_group(768, 1024, 1024, 64, 2, True) == 1
+                 and fa._pick_group(768, 256, 256, 64, 2, True) == 4),
+        "the fwd group picker's decisions at the shipped tile sizes "
+        "(512-tile g=3, 1024-tile g=1, 256-tile cap-bound g=4) are the "
+        "configurations all recorded perf numbers were measured at — a "
+        "budget or estimator edit that shifts them invalidates BASELINE.md",
+    ),
+    # --- tiled two-pass backward -----------------------------------------
+    VmemCheck(
+        "tiled-bwd-512-rope-fits",
+        lambda: _fits(fa.tiled_bwd_vmem_bytes(512, 512, 64, 2, g=1,
+                                              has_rope=True)),
+        "tiled bwd runs 512-tiles under fused rope (the enforced cap)",
+    ),
+    VmemCheck(
+        "tiled-bwd-1024-rope-blows",
+        lambda: not _fits(fa.tiled_bwd_vmem_bytes(1024, 1024, 64, 2, g=1,
+                                                  has_rope=True)),
+        "1024-tile tiled bwd + 4 fp32 rope table blocks = 18.3M > 16M "
+        "scoped VMEM (found by ctx-65536 training)",
+    ),
+    VmemCheck(
+        "tiled-bwd-1024-no-rope-fits",
+        lambda: _fits(fa.tiled_bwd_vmem_bytes(1024, 1024, 64, 2, g=1,
+                                              has_rope=False)),
+        "without rope the 1024-tile tiled bwd compiles (the cap is "
+        "rope-specific)",
+    ),
+    # --- fused single-pass backward --------------------------------------
+    VmemCheck(
+        "fused-bwd-s1024-bf16-fits",
+        lambda: _fits(fa.fused_bwd_vmem_bytes(1024, 64, 2)),
+        "fused bwd handles S=1024 bf16 (~14 MB live S×S set, verified)",
+    ),
+    VmemCheck(
+        "fused-bwd-s1024-fp32-blows",
+        lambda: not _fits(fa.fused_bwd_vmem_bytes(1024, 64, 4)),
+        "fused bwd at S=1024 fp32 (~24 MB) is an on-chip Mosaic compile "
+        "failure — hence the dtype-aware _BWD_PALLAS_MAX_S bound",
+    ),
+    VmemCheck(
+        "fused-bwd-s512-fp32-fits",
+        lambda: _fits(fa.fused_bwd_vmem_bytes(512, 64, 4)),
+        "fused bwd handles S=512 fp32 (the fp32 bound)",
+    ),
+    VmemCheck(
+        "fused-bwd-max-s-consistent",
+        lambda: all(
+            _fits(fa.fused_bwd_vmem_bytes(fa.fused_bwd_max_s(it), 64, it))
+            and not _fits(
+                fa.fused_bwd_vmem_bytes(2 * fa.fused_bwd_max_s(it), 64, it))
+            for it in (2, 4)
+        ),
+        "the dispatch bound fused_bwd_max_s must sit exactly one doubling "
+        "below the estimator's limit for both dtypes",
+    ),
+    # --- Mosaic crash matrix ---------------------------------------------
+    VmemCheck(
+        "mosaic-crash-matrix-cap",
+        lambda: (fa.fwd_group_cap(4, 16) == 2
+                 and fa.fwd_group_cap(2, 16) == 4
+                 and fa.fwd_group_cap(4, 32) == 4
+                 and fa.fwd_group_cap(2, 64) == 4),
+        "fp32 × d_head=16 × fwd G=4 crashes the Mosaic compiler; g<=2, "
+        "bf16 g=4 and fp32 d>=32 g=4 all compile (bisected on chip)",
+    ),
+    VmemCheck(
+        "mosaic-crash-matrix-picker",
+        lambda: fa._pick_group(8, 128, 128, 16, 4) <= 2,
+        "_pick_group must never hand fp32 d<32 a crashing G=4 even when "
+        "VMEM would allow it",
+    ),
+    # --- grouped matmul ---------------------------------------------------
+    VmemCheck(
+        "gmm-picked-tiles-fit",
+        lambda: all(
+            _fits(gm.gmm_vmem_bytes(256, gm._pick_tile(n, k, it), k, it))
+            for (n, k, it) in ((3072, 1024, 2), (8192, 2048, 2),
+                               (10240, 2560, 2))
+        ),
+        "bm=256 with _pick_tile'd bn keeps every grid step double-buffered "
+        "inside scoped VMEM (probe_gmm/check_gmm_chip configs)",
+    ),
+    VmemCheck(
+        "gmm-fused-w13-fits",
+        # the fused launch tiles bn with DOUBLED itemsize (w1 AND w3
+        # stream per step — grouped_matmul.py's `2 * w1.dtype.itemsize`)
+        lambda: _fits(gm.gmm_vmem_bytes(
+            256, gm._pick_tile(3072, 1024, 2 * 2), 1024, 2,
+            fused_w13=True)),
+        "the round-5 fused gate/up+silu·mul kernel (two weight blocks + "
+        "h/g residual blocks) still fits at the bm=256 default",
+    ),
+    VmemCheck(
+        "tiled-bwd-picker-pinned",
+        lambda: fa._pick_group_tiled_bwd(768, 512, 512, 64, 2, True) == 2,
+        "the tiled-bwd group picker's 512-tile fused-rope decision (g=2) "
+        "is what the recorded sweeps ran at",
+    ),
+    # --- decode serving ---------------------------------------------------
+    VmemCheck(
+        "decode-slab-budget",
+        lambda: all(
+            da.decode_vmem_bytes(
+                da._pick_group(8, s, 256, 2, 128), s, 256, 2)
+            <= da.DECODE_SLAB_BUDGET
+            for s in (256, 1024, 4096)
+        ),
+        "_pick_group's packed K‖V slab choice stays under the 8 MB budget "
+        "across serving context lengths",
+    ),
+    VmemCheck(
+        "decode-supported-agrees",
+        lambda: da.supported(1024, 128, 2) and da.supported(4096, 128, 2),
+        "the serving dispatcher's supported() gate must accept the "
+        "benchmark's real configs (d=128 packed to 256 lanes)",
+    ),
+)
+
+
+def run_vmem_checks() -> list[Violation]:
+    out = []
+    for c in CHECKS:
+        try:
+            ok = c.holds()
+        except Exception as e:  # estimator/picker raised — also a failure
+            out.append(Violation(
+                "vmem-budget", c.name,
+                f"check raised {type(e).__name__}: {e} (fact: {c.chip_fact})",
+            ))
+            continue
+        if not ok:
+            out.append(Violation(
+                "vmem-budget", c.name,
+                f"estimator disagrees with the on-chip record: {c.chip_fact}",
+            ))
+    return out
+
+
+def estimate_report() -> list[tuple[str, float]]:
+    """(name, estimated MB) rows for the human lint report — the headline
+    configurations only, for eyeballing headroom."""
+    rows = [
+        ("flash fwd 1024-tile bf16 d64 rope",
+         fa.fwd_vmem_bytes(1024, 1024, 64, 2, g=1, has_rope=True)),
+        ("flash fwd 512-tile g4 bf16 d64 rope",
+         fa.fwd_vmem_bytes(512, 512, 64, 2, g=4, has_rope=True)),
+        ("tiled bwd 512-tile bf16 d64 rope",
+         fa.tiled_bwd_vmem_bytes(512, 512, 64, 2, g=1, has_rope=True)),
+        ("fused bwd S=1024 bf16 d64",
+         fa.fused_bwd_vmem_bytes(1024, 64, 2)),
+        ("gmm fused-w13 bm256 bn1024 k1024 bf16",
+         gm.gmm_vmem_bytes(256, 1024, 1024, 2, fused_w13=True)),
+        ("decode slab g8 S=1024 w256 bf16",
+         da.decode_vmem_bytes(8, 1024, 256, 2)),
+    ]
+    return [(name, b / _MB) for name, b in rows]

@@ -204,6 +204,9 @@ def benchmark_decode(
             import numpy as _np
 
             lens = _np.linspace(prompt_len / 4, prompt_len, b).round().astype(int)
+            # linspace(P/4, ...) rounds to 0 for P < 4 and a zero-length
+            # row aborts the host range check — every row needs >= 1 token
+            lens = _np.clip(lens, 1, prompt_len)
             lens[-1] = prompt_len
             # pass the HOST array: prompt_lens is range-validated on the
             # host, so a per-call device jnp array would cost one

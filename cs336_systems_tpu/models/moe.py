@@ -258,6 +258,30 @@ def _shard_index(axes) -> jax.Array:
     return idx
 
 
+def _gather_counts(local_count: jax.Array, axes) -> jax.Array:
+    """All-gather per-expert counts over the token-sharding axes into
+    ``[W, E]`` rows ordered by ``_shard_index``: each axis is gathered
+    EXPLICITLY, innermost (last-named) axis first, so after the row-major
+    reshape row ``i1·s2 + i2`` is the shard whose raveled index is
+    ``i1·s2 + i2`` *by construction*. A single tuple-axis ``all_gather``
+    would leave that interleaving to a JAX stacking convention — a
+    convention change would silently reorder the global drop decisions;
+    here it instead fails the shape assertion loudly."""
+    if isinstance(axes, str):
+        return jax.lax.all_gather(local_count, axes)  # [W, E]
+    counts = local_count
+    for a in reversed(tuple(axes)):
+        counts = jax.lax.all_gather(counts, a)
+    sizes = tuple(jax.lax.axis_size(a) for a in axes)
+    expect = sizes + local_count.shape
+    if counts.shape != expect:
+        raise AssertionError(
+            f"gathered counts layout {counts.shape} != axis-ordered "
+            f"{expect} — the global fill order would be scrambled"
+        )
+    return counts.reshape(-1, local_count.shape[-1])
+
+
 def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
                        dp_axis=None):
     """Index-form routing: the same GShard priority fill as ``route_topk``
@@ -287,9 +311,7 @@ def route_topk_indexed(gates: jax.Array, top_k: int, capacity: int,
         onehot = jax.nn.one_hot(idx[:, j], e, dtype=jnp.int32)  # [T, E]
         local_count = jnp.sum(onehot, axis=0)  # [E]
         if dp_axis is not None:
-            counts = jax.lax.all_gather(local_count, dp_axis)  # [W, E]
-            if counts.ndim > 2:  # tuple axes gather one dim per axis
-                counts = counts.reshape(-1, e)
+            counts = _gather_counts(local_count, dp_axis)  # [W, E]
             w = _shard_index(dp_axis)
             prev_shards = jnp.sum(
                 jnp.where(jnp.arange(counts.shape[0])[:, None] < w, counts, 0),

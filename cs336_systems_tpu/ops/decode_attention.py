@@ -134,6 +134,19 @@ def _decode_update_kernel(pos_ref, qp_ref, newt_ref, kv_ref, kvtile_ref,
     kvtile_ref[:] = jnp.where(row == pos - base, newt_ref[:], orig)
 
 
+DECODE_SLAB_BUDGET = 8 * 1024 * 1024
+
+
+def decode_vmem_bytes(g: int, attend: int, w: int, itemsize: int) -> int:
+    """Static VMEM estimate for the packed-KV decode kernel at group size
+    ``g``: the double-buffered [g, attend, w] cache slab — the dominant
+    (and budgeted) term; the q/newt/out blocks are [g, 8, w] rounding
+    error next to it. The analysis linter asserts this against
+    ``DECODE_SLAB_BUDGET`` with the same arithmetic ``_pick_group`` fills
+    toward, so the estimator and the picker cannot drift."""
+    return 2 * g * attend * w * itemsize
+
+
 def _pick_group(rows: int, s: int, w: int, itemsize: int,
                 d: int, head_divisor: int | None = None) -> int | None:
     """Largest group keeping the double-buffered packed slab inside VMEM
@@ -161,7 +174,7 @@ def _pick_group(rows: int, s: int, w: int, itemsize: int,
     for g in groups:
         if head_divisor is not None and head_divisor % g:
             continue
-        if rows % g == 0 and g * s * w * itemsize * 2 <= 8 * 1024 * 1024:
+        if rows % g == 0 and decode_vmem_bytes(g, s, w, itemsize) <= DECODE_SLAB_BUDGET:
             return g
     return None
 

@@ -486,34 +486,55 @@ def _flash_kernel(q_ref, k_ref, v_ref, *refs,
         )
 
 
-def _pick_group(b: int, bq: int, bk: int, d: int, itemsize: int,
-                has_rope: bool = False) -> int:
-    """Largest divisor of ``b`` whose per-grid-step VMEM footprint fits.
+# VMEM soft budgets the group pickers fill toward (calibrated on v5e; the
+# hardware scoped-VMEM hard limit is 16 MB — analysis/vmem.py asserts every
+# shipped tile/group configuration's ESTIMATE stays under it, using the
+# same arithmetic below, so the estimator and the pickers cannot drift).
+FWD_VMEM_BUDGET = 14 * 1024 * 1024
+TILED_BWD_VMEM_BUDGET = 12 * 1024 * 1024
 
-    Estimate per group row: s+p fp32 tiles (the dominant term), the
-    double-buffered q/k/v/o blocks, the lse block, and the m/l/acc scratch.
-    The 14 MB budget was calibrated on v5e (G=4 at bq=bk=512, d=64 bf16
-    compiles and is the measured optimum; G=6 compiles but regresses, G=8
-    exceeds VMEM). Fused rope adds the 4 double-buffered fp32 table blocks
-    (group-shared: charged to the budget, not per row) and per-row fp32
-    rotation temporaries.
-    """
-    budget = 14 * 1024 * 1024
+
+def fwd_group_cap(itemsize: int, d: int) -> int:
+    """Mosaic crash matrix, as data: fp32 with a tiny head dim (d=16) at
+    G=4 crashes the Mosaic compiler (remote tpu_compile_helper exit 1;
+    bisected on chip: g<=2 compiles, bf16 g=4 compiles, fp32 d>=32 g=4
+    compiles). Cap the narrow case to 2; everything else to the measured
+    G=4 optimum."""
+    return 2 if itemsize == 4 and d < 32 else 4
+
+
+def fwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int, g: int = 1,
+                   has_rope: bool = False) -> int:
+    """Static per-grid-step VMEM estimate for the forward kernel at group
+    size ``g`` — from the BlockSpecs/dtypes alone. Per group row: s+p fp32
+    tiles (the dominant term), the double-buffered q/k/v/o blocks, the lse
+    block, and the m/l/acc scratch. Fused rope adds the 4 double-buffered
+    fp32 table blocks (group-shared: charged once, not per row) and
+    per-row fp32 rotation temporaries."""
     per_row = (
         2 * bq * bk * 4  # s, p fp32
         + 2 * 2 * (bq + bk) * d * itemsize  # q/o + k/v blocks, double-buffered
         + 2 * 2 * bq * 128 * 4  # lse block (double-buffered) + m/l scratch
         + bq * d * 4  # acc scratch
     )
+    shared = 0
     if has_rope:
-        budget -= 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks, double-buffered
+        shared = 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks, double-buffered
         # fp32 rotation temporaries + the rotated-q VMEM stash
         per_row += 2 * (bq + bk) * d * 4 + bq * d * itemsize
-    # fp32 with a tiny head dim (d=16) at G=4 crashes the Mosaic compiler
-    # (remote tpu_compile_helper exit 1; bisected on chip: g<=2 compiles,
-    # bf16 g=4 compiles, fp32 d>=32 g=4 compiles). Cap the narrow case.
-    cap = 2 if itemsize == 4 and d < 32 else 4
-    g = max(1, min(b, budget // per_row, cap))
+    return g * per_row + shared
+
+
+def _pick_group(b: int, bq: int, bk: int, d: int, itemsize: int,
+                has_rope: bool = False) -> int:
+    """Largest divisor of ``b`` whose per-grid-step VMEM footprint
+    (``fwd_vmem_bytes``) fits ``FWD_VMEM_BUDGET``, capped by the Mosaic
+    crash matrix (``fwd_group_cap``). The 14 MB budget was calibrated on
+    v5e (G=4 at bq=bk=512, d=64 bf16 compiles and is the measured optimum;
+    G=6 compiles but regresses, G=8 exceeds VMEM)."""
+    g = max(1, min(b, fwd_group_cap(itemsize, d)))
+    while g > 1 and fwd_vmem_bytes(bq, bk, d, itemsize, g, has_rope) > FWD_VMEM_BUDGET:
+        g -= 1
     while b % g:
         g -= 1
     return g
@@ -648,6 +669,27 @@ def _flash_fwd_pallas(q, k, v, causal: bool, q_tile: int, k_tile: int,
 # take over (O(tile²) VMEM, any length).
 _BWD_PALLAS_MAX_S_BF16 = 1024
 _BWD_PALLAS_MAX_S_F32 = 512
+
+
+def fused_bwd_max_s(itemsize: int) -> int:
+    """Longest sequence the fused single-pass backward handles, by input
+    itemsize — the dtype-aware VMEM bound above, as a hook for the static
+    analysis layer (analysis/vmem.py validates its estimator against it)."""
+    return _BWD_PALLAS_MAX_S_F32 if itemsize == 4 else _BWD_PALLAS_MAX_S_BF16
+
+
+def fused_bwd_vmem_bytes(s: int, d: int, itemsize: int, g: int = 1) -> int:
+    """Static VMEM estimate for the fused single-pass backward: live S×S
+    tensors per row — s and p in fp32, dp in fp32, ds in the input dtype
+    (pb reuses the s/p storage) — plus the [S, d] operand/output blocks
+    (q/k/v/o/do and dq/dk/dv). Reproduces the chip boundary: ~15.7 MB at
+    S=1024 bf16 (compiles), ~19 MB at S=1024 fp32 (Mosaic VMEM failure)."""
+    per_row = (
+        3 * s * s * 4  # s/p, dp fp32
+        + s * s * itemsize  # ds in input dtype (pb shares s/p)
+        + 8 * s * d * itemsize  # q/k/v/o/do blocks + dq/dk/dv outputs
+    )
+    return g * per_row
 
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, *rest,
@@ -953,24 +995,36 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int,
-                          has_rope: bool = False) -> int:
-    """Group size for the two-pass tiled backward kernels (same rationale as
-    ``_pick_group``). Only applied at small tile counts — ``_gate_group``
-    measured a ~20% win at tq=tk=4 (S=2048) but a wash from tk≈16 up, so
-    very long sequences (S=65,536: tq=tk=128) intentionally run per-row."""
-    budget = 12 * 1024 * 1024
+def tiled_bwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int,
+                         g: int = 1, has_rope: bool = False) -> int:
+    """Static per-grid-step VMEM estimate for the two-pass tiled backward
+    kernels at group size ``g`` (companion of ``fwd_vmem_bytes``)."""
     per_row = (
         3 * bq * bk * 4  # s/p, dp fp32 tiles
         + bq * bk * itemsize  # ds in input dtype
         + 2 * 2 * (bq + bk) * d * itemsize  # q/do + k/v blocks, double-buffered
         + 2 * bk * d * 4  # dk/dv (or dq) accumulators
     )
+    shared = 0
     if has_rope:
-        budget -= 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks (group-shared)
+        shared = 2 * 2 * (bq + bk) * d * 4  # cos/sin blocks (group-shared)
         # fp32 rotation temporaries + the rotated-operand VMEM stash
         per_row += 2 * (bq + bk) * d * 4 + max(bq, bk) * d * itemsize
-    g = max(1, min(b, budget // per_row, 8))
+    return g * per_row + shared
+
+
+def _pick_group_tiled_bwd(b: int, bq: int, bk: int, d: int, itemsize: int,
+                          has_rope: bool = False) -> int:
+    """Group size for the two-pass tiled backward kernels (same rationale as
+    ``_pick_group``, budget ``TILED_BWD_VMEM_BUDGET``). Only applied at
+    small tile counts — ``_gate_group`` measured a ~20% win at tq=tk=4
+    (S=2048) but a wash from tk≈16 up, so very long sequences (S=65,536:
+    tq=tk=128) intentionally run per-row."""
+    g = max(1, min(b, 8))
+    while g > 1 and tiled_bwd_vmem_bytes(
+        bq, bk, d, itemsize, g, has_rope
+    ) > TILED_BWD_VMEM_BUDGET:
+        g -= 1
     while b % g:
         g -= 1
     return g

@@ -76,6 +76,24 @@ def _pick_tile(full: int, other: int, itemsize: int) -> int:
         f"model dim to a power-of-two multiple of 128")
 
 
+def gmm_vmem_bytes(bm: int, bn: int, k: int, itemsize: int,
+                   fused_w13: bool = False) -> int:
+    """Static per-grid-step VMEM estimate for the grouped-matmul kernels,
+    from the BlockSpecs/dtypes alone (the analysis linter's hook): the
+    double-buffered x row block [bm, k], weight block [bn, k], output
+    block [bm, bn], and the fp32 accumulator. ``fused_w13`` doubles the
+    weight block (w1 AND w3 stream per grid step) and adds the h/g
+    residual blocks the fused kernel writes for the backward."""
+    w_blocks = 2 if fused_w13 else 1
+    extra_out = 2 if fused_w13 else 0  # h, g residual blocks
+    return (
+        2 * bm * k * itemsize  # x block, double-buffered
+        + 2 * w_blocks * bn * k * itemsize  # weight block(s), double-buffered
+        + 2 * (1 + extra_out) * bm * bn * itemsize  # output (+ residuals)
+        + bm * bn * 4  # fp32 accumulator
+    )
+
+
 def _gmm_fwd_kernel(te_ref, x_ref, w_ref, y_ref):
     del te_ref
     # y[m, o] = x[m, i] · w[o, i] — contract the shared K dim

@@ -163,6 +163,26 @@ def sync_grads(
     return put_back(synced)
 
 
+def lint_contract(params, variant: str = "bucketed",
+                  bucket_size_mb: float = 1000.0) -> dict:
+    """Declared collective contract of ``make_dp_train_step`` for the
+    static analysis linter (analysis/registry.py) — derived from the SAME
+    ``collective_groups`` the step issues from, so the expected count and
+    the issued count cannot drift independently: ``psum`` = one fused
+    pmean per gradient group + the loss pmean. Everything else is zero —
+    a dp train step that grows an all_gather or all_to_all is a bug."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if variant == "naive":
+        n_groups = len(leaves)
+    else:
+        n_groups = len(collective_groups(leaves, variant, bucket_size_mb))
+    return {
+        "collectives": {"psum": n_groups + 1},
+        "note": f"dp[{variant}]: one grad pmean per group ({n_groups}) "
+                "+ the loss pmean",
+    }
+
+
 def make_dp_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,

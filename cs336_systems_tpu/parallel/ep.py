@@ -106,24 +106,83 @@ def shard_params_ep(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "ep"
     return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
-def _ep_grad_norm(grads, ep_axis: str):
+def ep_sharded_mask(cfg: TransformerConfig, ep_axis: str):
+    """Boolean pytree (the params' structure) marking the leaves whose
+    PartitionSpec shards over ``ep_axis`` — derived from ``param_specs``
+    so consumers (the clip-norm partition below, the analysis linter's
+    contract registry) cannot drift from the sharding layout. A leaf is
+    ep-sharded iff its spec names the axis, alone or inside a tuple
+    entry."""
+
+    def has_axis(spec: P) -> bool:
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if ep_axis in axes:
+                return True
+        return False
+
+    return jax.tree_util.tree_map(
+        has_axis, param_specs(cfg, ep_axis),
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def _ep_grad_norm(grads, ep_mask, ep_axis: str):
     """Global L2 gradient norm when expert leaves are ep-sharded: expert
     square-norms psum over ``ep_axis`` (each shard holds E/W experts),
     dense leaves count once (replicated — their grads are identical on
     every shard). Keeping this OUT of the local norm would give each
     shard a different clip scale and silently diverge the replicated
-    params."""
+    params. ``ep_mask``: boolean pytree from ``ep_sharded_mask`` —
+    classification follows the sharding spec, not tree-key names."""
     import jax.numpy as jnp
 
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    mask = treedef.flatten_up_to(ep_mask)
     dense_sq = jnp.zeros((), jnp.float32)
     exp_sq = jnp.zeros((), jnp.float32)
-    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+    for is_exp, g in zip(mask, leaves):
         sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        if any(getattr(k, "key", None) == "experts" for k in path):
+        if is_exp:
             exp_sq = exp_sq + sq
         else:
             dense_sq = dense_sq + sq
     return jnp.sqrt(dense_sq + jax.lax.psum(exp_sq, ep_axis))
+
+
+def lint_contract(cfg: TransformerConfig, n_token_axes: int = 2) -> dict:
+    """Declared contract of ``make_ep_train_step(variant="a2a")`` for the
+    static analysis linter, per unrolled MoE layer (L = num_layers,
+    A = n_token_axes, k = moe_top_k):
+
+    - ``all_to_all`` = 5L: 3 forward per layer (claim rows out, their
+      int32 slot indices out, expert outputs back —
+      models/moe._moe_ffn_ep_a2a) + 2 backward transposes (the two float
+      a2as; the int32 slot a2a has no cotangent).
+    - ``all_gather`` = k·A·L: routing gathers the [W, E] claim counts
+      once per priority per token axis (models/moe._gather_counts walks
+      the axes in order so the global fill order is well-defined).
+    - ``psum`` = 3L + 3: per layer, the aux-loss pmean pair and the
+      count reduction the global capacity derives from; step-level, the
+      loss pmean, its transpose in the backward, and the expert-shard
+      grad-norm psum (``_ep_grad_norm``).
+    - barriers ≥ 2L: the per-layer ``optimization_barrier`` (forward +
+      its companion in the backward) that pins the per-layer weight
+      casts (transformer.py — 47.9 ms/step when absent).
+
+    The ``"dense"`` variant is GSPMD (zero jaxpr collectives, like tp).
+    """
+    L = cfg.num_layers
+    return {
+        "collectives": {
+            "all_to_all": 5 * L,
+            "all_gather": cfg.moe_top_k * n_token_axes * L,
+            "psum": 3 * L + 3,
+        },
+        "barriers": 2 * L if not cfg.scan_layers else 0,
+        "note": "ep[a2a]: 5 a2a + k·axes gathers per MoE layer; "
+                "3 psums per layer + 3 step-level",
+    }
 
 
 def make_ep_train_step(
@@ -178,6 +237,7 @@ def make_ep_train_step(
         batch_spec = P(token_axes)
         pspecs = param_specs(cfg, ep_axis)
         ospecs = opt_state_specs(cfg, ep_axis)
+        ep_mask = ep_sharded_mask(cfg, ep_axis)
 
         def sharded_loss(p, x, y):
             return jax.lax.pmean(lm_loss(p, x, y, cfg=ecfg), token_axes)
@@ -186,7 +246,7 @@ def make_ep_train_step(
             loss, grads = jax.value_and_grad(sharded_loss)(p, x, y)
             if clip_norm is not None:
                 grads = clip_gradients(
-                    grads, clip_norm, norm=_ep_grad_norm(grads, ep_axis)
+                    grads, clip_norm, norm=_ep_grad_norm(grads, ep_mask, ep_axis)
                 )
             return loss, grads
 

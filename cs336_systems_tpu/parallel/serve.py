@@ -98,6 +98,44 @@ def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None,
     return specs
 
 
+def lint_contract(cfg: TransformerConfig, dp_axis: str | None = None,
+                  tp_axis: str | None = None,
+                  ep_axis: str | None = None) -> dict:
+    """Declared collective contract of ``make_sharded_generate`` for the
+    static analysis linter (static call-site counts in the traced
+    generation program, L = num_layers):
+
+    - dp only: ZERO collectives — the whole point of the row-keyed
+      design (bit-identical rows, nothing crosses the batch axis).
+      Ragged lens change per-row write columns, not communication.
+    - tp: 2L + 2 psums. The decode scan body unrolls the layer loop
+      over the unstacked per-layer params (models/decode._generate_scan)
+      — one psum per block pair (attention out-projection + FFN
+      down-projection), 2L sites counted once for the scan; prefill runs
+      the scanned-blocks transformer body, 2 more. approx/exact top-k
+      sampling adds none (the head is replicated in serving —
+      serve_param_specs). Counts assume ONE generation segment (small
+      max_new_tokens); every extra attend-bucket segment repeats the
+      body's sites.
+    - ep (MoE): L + 1 psums — ONE fp32 combine psum per MoE layer in
+      the decode body (models/moe.moe_ffn_ep_local) plus the prefill
+      body's.
+
+    tp and ep compose additively (disjoint axes, disjoint psum sites).
+    """
+    L = cfg.num_layers
+    psum = 0
+    if tp_axis is not None:
+        psum += 2 * L + 2
+    if ep_axis is not None:
+        psum += L + 1
+    return {
+        "collectives": {"psum": psum},
+        "note": "serve: dp=0 collectives; tp=2L+2 psums; ep=L+1 psums "
+                "(additive)",
+    }
+
+
 def make_sharded_generate(
     cfg: TransformerConfig,
     mesh: Mesh,

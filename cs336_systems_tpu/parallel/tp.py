@@ -90,6 +90,20 @@ def shard_params(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "tp"):
     return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
+def lint_contract() -> dict:
+    """Declared contract of ``make_tp_train_step`` for the static analysis
+    linter: a GSPMD step — the jaxpr carries ZERO collectives (XLA inserts
+    the matmul all-reduces and dp grad averaging at compile time from the
+    in/out shardings), so any collective appearing in the trace means a
+    shard_map/pmean crept into a path that is supposed to be
+    sharding-annotated. Donation must still alias the full train state."""
+    return {
+        "collectives": {},
+        "note": "tp (GSPMD): collectives are compile-time-inserted, "
+                "none may appear in the jaxpr",
+    }
+
+
 def make_tp_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,

@@ -56,6 +56,28 @@ def validate_tp_sp(cfg: TransformerConfig, mesh: Mesh,
         )
 
 
+def lint_contract(cfg: TransformerConfig) -> dict:
+    """Declared contract of ``make_tp_sp_train_step`` for the static
+    analysis linter. GSPMD inserts the tp/dp collectives at compile time,
+    but the ring-attention shard_map island IS visible in the jaxpr:
+    with ``scan_layers=True`` (the only layout this contract covers) the
+    scanned block body counts ONCE regardless of depth — 4 static
+    ppermute sites (the fwd ring's 2 K/V hops + their transposes in the
+    ring backward) and 3 psums (the island's loss/norm reductions). These
+    are call-SITE counts, the granularity every contract here uses."""
+    if not cfg.scan_layers:
+        raise ValueError(
+            "tp_sp lint contract is calibrated for scan_layers=True "
+            "(unrolled stacks multiply the ring's static sites per layer)"
+        )
+    return {
+        "collectives": {"psum": 3, "ppermute": 4},
+        "note": "tp×sp: ring shard_map island in the scanned block body "
+                "(4 ppermute sites fwd+bwd, 3 psums); all tp/dp "
+                "collectives are GSPMD compile-time",
+    }
+
+
 def make_tp_sp_train_step(
     cfg: TransformerConfig,
     hp: AdamWHparams,

@@ -18,6 +18,14 @@ JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
 python -m pytest -v tests/ --junitxml=test_results.xml
 status=$?
 
+# graft-lint gate: the static performance-contract checks must pass too
+# (collective counts per sharding family, donation aliasing, TPU
+# anti-pattern lints, Pallas VMEM budgets — see analysis/README.md).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.lint
+lint_status=$?
+[ "$status" -eq 0 ] && status=$lint_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -1,0 +1,206 @@
+"""graft-lint tests: the linter must (a) pass the real repo clean and
+(b) FLAG each seeded violation with its rule-specific diagnostic.
+
+The mutation tests re-introduce, one at a time, the exact regressions the
+rules encode — donation switched off, raw ``lax.cumsum`` routing, a
+barrier-stripped unrolled MoE stack, a VMEM budget edit that shifts the
+Pallas group picker — and assert the matching rule fires. This is the
+same oracle discipline as the parallelism tests: the checker is tested
+against known-bad programs, not assumed correct.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cs336_systems_tpu.analysis import contracts, jaxpr_scan, registry, vmem
+from cs336_systems_tpu.analysis.lint import lint_step, run
+from cs336_systems_tpu.ops import flash_attention as fa
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+# --- the real repo is clean -------------------------------------------------
+
+
+def test_full_lint_clean():
+    """Every registered step + the VMEM facts pass on the current tree.
+    This is the gate scripts/run_tests_and_package.sh runs."""
+    results, violations, errors = run()
+    assert not errors, [v.message for v in errors]
+    assert not violations, [v.message for v in violations]
+    assert len(results) == len(registry.STEPS) + 1  # + vmem
+
+
+# --- collective contracts ---------------------------------------------------
+
+
+def test_collective_contract_flags_extra_psum():
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 8})
+
+    def fn(x):
+        return jax.lax.pmean(x, "dp")
+
+    sm = jax.shard_map(fn, mesh=mesh, in_specs=(jax.sharding.PartitionSpec("dp"),),
+                       out_specs=jax.sharding.PartitionSpec("dp"))
+    jaxpr = jax.make_jaxpr(sm)(jax.ShapeDtypeStruct((8, 4), jnp.float32))
+    # pmean traces to psum; a zero-collective contract must flag it
+    vs = contracts.check_collectives("t", jaxpr, {})
+    assert _rules(vs) == {"collective-contract"}
+    assert "psum: 1 issued, contract says 0" in vs[0].message
+    # and the correct count passes
+    assert contracts.check_collectives("t", jaxpr, {"psum": 1}) == []
+
+
+def test_collective_counts_are_static_sites():
+    """A collective inside a lax.scan body counts ONCE (the granularity
+    every declared contract uses)."""
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 8})
+
+    def fn(x):
+        def body(c, _):
+            return jax.lax.psum(c, "dp"), None
+
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    sm = jax.shard_map(fn, mesh=mesh,
+                       in_specs=(jax.sharding.PartitionSpec(),),
+                       out_specs=jax.sharding.PartitionSpec())
+    jaxpr = jax.make_jaxpr(sm)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert jaxpr_scan.count_collectives(jaxpr)["psum"] == 1
+
+
+# --- donation ---------------------------------------------------------------
+
+
+def test_donation_mutation_flagged():
+    """make_train_step(donate=False) must trip the donation rule that the
+    donated build passes."""
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = registry._tiny_cfg()
+    state = registry._abstract_state(cfg)
+    x, y = registry._batch(cfg)
+    n = registry._n_leaves(state)
+
+    good = jaxpr_scan.lowered_text(
+        make_train_step(cfg, registry._hp(), donate=True), *state, x, y)
+    assert contracts.check_donation("t", good, n) == []
+
+    bad = jaxpr_scan.lowered_text(
+        make_train_step(cfg, registry._hp(), donate=False), *state, x, y)
+    vs = contracts.check_donation("t", bad, n)
+    assert _rules(vs) == {"donation"}
+    assert "donate_argnums is not taking effect" in vs[0].message
+
+
+# --- routing cumsum ---------------------------------------------------------
+
+
+def test_raw_cumsum_routing_flagged():
+    """The 2.1 ms hazard: lax.cumsum over a [16384, 8] routing tensor.
+    models/moe._prefix_count exists so this never appears in a step."""
+
+    def bad_routing(mask):
+        return jnp.cumsum(mask, axis=0)  # positions via prefix-count: BAD
+
+    jaxpr = jax.make_jaxpr(bad_routing)(
+        jax.ShapeDtypeStruct((16384, 8), jnp.int32))
+    vs = contracts.check_no_big_cumsum("t", jaxpr)
+    assert _rules(vs) == {"routing-cumsum"}
+    assert "16384" in vs[0].message and "_prefix_count" in vs[0].message
+
+
+def test_small_cumsum_not_flagged():
+    """The [E+1] expert-offset cumsum inside tile_maps is harmless and
+    must stay allowed."""
+    jaxpr = jax.make_jaxpr(lambda m: jnp.cumsum(m))(
+        jax.ShapeDtypeStruct((9,), jnp.int32))
+    assert contracts.check_no_big_cumsum("t", jaxpr) == []
+
+
+def test_registered_moe_steps_use_prefix_count():
+    """The real sorted MoE step carries NO long cumsum — the whole point
+    of _prefix_count."""
+    traced = registry.STEPS[2].build()  # train_moe_sorted
+    assert contracts.check_no_big_cumsum("moe", traced.jaxpr) == []
+
+
+# --- MoE barriers -----------------------------------------------------------
+
+
+def test_barrier_stripped_moe_flagged(monkeypatch):
+    """Stripping the per-layer optimization_barrier (the 47.9 ms/step
+    regression) must trip the moe-barrier rule on the SAME build that
+    passes un-stripped."""
+    monkeypatch.setattr(jax.lax, "optimization_barrier", lambda x: x)
+    traced = registry.STEPS[2].build()  # train_moe_sorted
+    vs = lint_step("train_moe_sorted", traced)
+    assert _rules(vs) == {"moe-barrier"}
+    assert "47.9 ms/step" in vs[0].message
+
+
+# --- fp32 big dots ----------------------------------------------------------
+
+
+def test_fp32_big_dot_flagged():
+    def bad(a, b):
+        return a @ b
+
+    jaxpr = jax.make_jaxpr(bad)(
+        jax.ShapeDtypeStruct((512, 512), jnp.float32),
+        jax.ShapeDtypeStruct((512, 512), jnp.float32))
+    vs = contracts.check_no_big_fp32_dots("t", jaxpr)
+    assert _rules(vs) == {"fp32-big-dot"}
+    assert "preferred_element_type" in vs[0].message
+
+
+def test_bf16_big_dot_and_small_fp32_dot_pass():
+    jaxpr = jax.make_jaxpr(lambda a, b: a @ b)(
+        jax.ShapeDtypeStruct((512, 512), jnp.bfloat16),
+        jax.ShapeDtypeStruct((512, 512), jnp.bfloat16))
+    assert contracts.check_no_big_fp32_dots("t", jaxpr) == []
+    # the fp32 router matmul shape ([T, D] x [D, E], E tiny) stays legal
+    jaxpr = jax.make_jaxpr(lambda a, b: a @ b)(
+        jax.ShapeDtypeStruct((16384, 512), jnp.float32),
+        jax.ShapeDtypeStruct((512, 8), jnp.float32))
+    assert contracts.check_no_big_fp32_dots("t", jaxpr) == []
+
+
+# --- VMEM budget facts ------------------------------------------------------
+
+
+def test_vmem_facts_hold():
+    assert vmem.run_vmem_checks() == []
+
+
+def test_vmem_budget_edit_flagged(monkeypatch):
+    """Raising the fwd budget would shift the group picker's shipped
+    decisions (every BASELINE.md number was measured at them) — the
+    pinned-picker check must catch the drift."""
+    monkeypatch.setattr(fa, "FWD_VMEM_BUDGET", 32 * 1024 * 1024)
+    vs = vmem.run_vmem_checks()
+    assert "flash-fwd-picker-pinned" in {v.where for v in vs}
+
+
+def test_vmem_over_budget_tile_detected():
+    """The estimators must reject the configs the chip rejected."""
+    assert fa.fwd_vmem_bytes(2048, 2048, 64, 2, g=1,
+                             has_rope=True) > vmem.SCOPED_VMEM_LIMIT
+    assert fa.tiled_bwd_vmem_bytes(1024, 1024, 64, 2, g=1,
+                                   has_rope=True) > vmem.SCOPED_VMEM_LIMIT
+    assert fa.fused_bwd_vmem_bytes(1024, 64, 4) > vmem.SCOPED_VMEM_LIMIT
+
+
+def test_mosaic_crash_matrix_enforced():
+    """fp32 × narrow head × G=4 is the on-chip compiler crash; the picker
+    may never choose it."""
+    assert fa.fwd_group_cap(4, 16) == 2
+    assert fa._pick_group(8, 128, 128, 16, 4) <= 2
