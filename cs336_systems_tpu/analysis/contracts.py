@@ -19,6 +19,10 @@ Every rule here encodes a regression the chip already taught us
 - ``fp32-big-dot`` — a large matmul with BOTH operands fp32 on a
   bf16-compute path is a silent 2× MXU-throughput loss; accumulation
   belongs in ``preferred_element_type``, not upcast operands.
+- ``gmm-fused-bwd`` — the fused-w13 backward must stay TWO pallas_calls
+  with the SiLU grads in-register; an extra call or a host-program
+  ``logistic`` is the five-pass dh/dg HBM round-trip coming back
+  (the round-5 b48-OOM live set).
 """
 
 from __future__ import annotations
@@ -114,6 +118,37 @@ def check_barriers(name: str, jaxpr, expected: int) -> list[Violation]:
             "missing barriers cost 47.9 ms/step in whole-stack cast remat",
         )]
     return []
+
+
+def check_gmm_fused_bwd(name: str, jaxpr,
+                        max_pallas_calls: int = 2) -> list[Violation]:
+    """The fused-w13 backward contract (round 6): the whole vjp lowers to
+    at most TWO pallas_calls (fused dx + fused dw) and carries NO
+    ``logistic`` outside a kernel body. A third call or a host-program
+    sigmoid is the five-pass pipeline reappearing — dh/dg materialized as
+    2×[M, N] HBM buffers, ~4·M·N bytes of round-trip traffic and the
+    live-set growth that made training b48 OOM under gmm."""
+    out = []
+    n_calls = jaxpr_scan.count_prim(jaxpr, "pallas_call")
+    if n_calls > max_pallas_calls:
+        out.append(Violation(
+            "gmm-fused-bwd", name,
+            f"{n_calls} pallas_calls in the w13 backward, contract says "
+            f"<= {max_pallas_calls} (fused dx + fused dw) — the unfused "
+            "dx/dw chain re-reads x and adds a separate fp32 dx pass",
+        ))
+    n_logistic = sum(
+        1 for eqn in jaxpr_scan.iter_eqns_outside_pallas(jaxpr)
+        if eqn.primitive.name == "logistic")
+    if n_logistic:
+        out.append(Violation(
+            "gmm-fused-bwd", name,
+            f"{n_logistic} logistic eqn(s) outside Pallas kernel bodies — "
+            "an XLA _silu_mul_grads pass materializing dh/dg in HBM; the "
+            "SiLU grads belong in-register inside the fused kernels "
+            "(ops/grouped_matmul._silu_grads_cast)",
+        ))
+    return out
 
 
 # A dot is "big" when M, N and K are ALL at least this: the fp32 router

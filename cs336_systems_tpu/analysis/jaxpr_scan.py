@@ -55,6 +55,22 @@ def iter_eqns(jaxpr) -> Iterator[Any]:
             yield from iter_eqns(sub)
 
 
+def iter_eqns_outside_pallas(jaxpr) -> Iterator[Any]:
+    """Like ``iter_eqns`` but does NOT descend into ``pallas_call`` kernel
+    bodies: every eqn yielded here runs as an XLA op in the host program.
+    That is the distinction the gmm fused-backward contract reads — SiLU
+    grads recomputed in-register inside a kernel are the design; the same
+    ``logistic`` appearing outside one is the five-pass dh/dg HBM
+    materialization coming back."""
+    core = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in core.eqns:
+        yield eqn
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns_outside_pallas(sub)
+
+
 def count_collectives(jaxpr) -> dict[str, int]:
     """Static call-site counts of the five collective classes."""
     counts = dict.fromkeys(COLLECTIVE_PRIMS, 0)

@@ -56,6 +56,8 @@ def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
     out += contracts.check_no_big_cumsum(name, traced.jaxpr)
     if c.get("check_fp32_dots"):
         out += contracts.check_no_big_fp32_dots(name, traced.jaxpr)
+    if c.get("gmm_fused_bwd"):
+        out += contracts.check_gmm_fused_bwd(name, traced.jaxpr)
     return out
 
 

@@ -20,6 +20,8 @@ Contract key glossary (consumed by ``lint.run``):
 - ``barriers``: minimum ``optimization_barrier`` count (unrolled MoE).
 - ``check_fp32_dots``: enable the fp32-big-dot lint (only meaningful on
   bf16-compute configs — fp32 configs are fp32 on purpose).
+- ``gmm_fused_bwd``: enforce the fused-w13 backward shape (<= 2
+  pallas_calls, no host-program ``logistic``).
 - The routing-cumsum lint always runs; no jaxpr here may carry a long
   cumsum/reduce_window.
 """
@@ -212,6 +214,42 @@ def _build_train_ep_a2a() -> Traced:
     return _traced_train(step, state, x, y, contract)
 
 
+# --- gmm fused backward (kernel-level, no mesh) -----------------------------
+
+
+def _build_gmm13_bwd() -> Traced:
+    """Trace the fused-w13 vjp backward DIRECTLY (not through a whole
+    train step, where the w2 grouped backward's own pallas_calls would
+    drown the count) at headline-like geometry — bm=256, E=8, N=3072
+    (d_ff), K=768 (d_model), bf16 — so the tile planner takes the same
+    branch the E8k2 chip runs: the fused path, with the row tile
+    subdivided below the packing's bm (analysis/vmem.py pins the picks)."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    bm, e, n, k = 256, 8, 3072, 768
+    m = e * bm  # one tile per expert — te/first trivially well-formed
+    bf16 = jnp.bfloat16
+    x = jax.ShapeDtypeStruct((m, k), bf16)
+    w = jax.ShapeDtypeStruct((e, n, k), bf16)
+    rows = jax.ShapeDtypeStruct((m, n), bf16)
+    ti = jax.ShapeDtypeStruct((m // bm,), jnp.int32)
+    ve = jax.ShapeDtypeStruct((e,), jnp.int32)
+
+    def bwd(x, w1, w3, h, g, te, first, visited, dp):
+        res = (x, w1, w3, h, g, te, first, visited)
+        return gm._gmm13_bwd(bm, None, res, dp)[:3]
+
+    jaxpr = jax.make_jaxpr(bwd)(x, w, w, rows, rows, ti, ti, ve, rows)
+    contract = {
+        "collectives": None,
+        "gmm_fused_bwd": True,
+        "note": "fused-w13 backward: <= 2 pallas_calls, SiLU grads "
+                "in-register (no host-program logistic) — "
+                "ops/grouped_matmul.py round-6 contract",
+    }
+    return Traced(jaxpr, None, contract)
+
+
 # --- serving ----------------------------------------------------------------
 
 
@@ -255,6 +293,7 @@ STEPS: tuple[StepSpec, ...] = (
     StepSpec("train_tp", _build_train_tp),
     StepSpec("train_tp_sp", _build_train_tp_sp),
     StepSpec("train_ep_a2a", _build_train_ep_a2a),
+    StepSpec("gmm_fused_bwd", _build_gmm13_bwd),
     StepSpec("serve_dp", functools.partial(_build_serve, {"dp": 8}, "dp")),
     StepSpec("serve_tp",
              functools.partial(_build_serve, {"tp": 4}, None, "tp")),

@@ -25,6 +25,9 @@ The caps themselves (as established on chip):
   compiler; g<=2, bf16 g=4, fp32 d>=32 g=4 all compile.
 - grouped matmul: weight blocks stream under the ~5 MB soft budget so
   the whole grid step double-buffers inside 16 MB.
+- gmm fused backward: the dx kernel's full-N dp/h/g row blocks cap its
+  row tile at 128 (the packing's bm=256 blows the limit on the operand
+  triplet alone); the dw kernel N-tiles its blocks and keeps bm=256.
 - decode: the packed-KV K‖V slab (double-buffered) stays under 8 MB so
   the attend window + merge tiles fit beside it.
 """
@@ -167,6 +170,40 @@ CHECKS: tuple[VmemCheck, ...] = (
         "h/g residual blocks) still fits at the bm=256 default",
     ),
     VmemCheck(
+        "gmm-fused-dx-picked-fits",
+        lambda: (gm._pick_dx_tiles(256, 3072, 768, 2) == (128, 256)
+                 and _fits(gm.gmm_fused_dx_vmem_bytes(128, 256, 3072, 2))),
+        "the fused dx kernel at headline E8k2 geometry (bm=256 packing, "
+        "N=3072, K=768, bf16) plans a SUBDIVIDED 128-row tile with bk=256 "
+        "— the configuration the round-6 numbers are measured at; a "
+        "budget/estimator edit that shifts it invalidates the record",
+    ),
+    VmemCheck(
+        "gmm-fused-dx-bm256-blows",
+        lambda: not _fits(gm.gmm_fused_dx_vmem_bytes(256, 256, 3072, 2)),
+        "running the fused dx at the packing's full bm=256 row tile blows "
+        "scoped VMEM on the full-N dp/h/g operand triplet alone — the "
+        "reason _subdivide_tiles exists (sub-tiles inherit the expert)",
+    ),
+    VmemCheck(
+        "gmm-fused-dw-picked-fits",
+        lambda: (gm._pick_dw_tiles(256, 3072, 768, 2) == (256, 512, 768)
+                 and _fits(gm.gmm_fused_dw_vmem_bytes(256, 512, 768, 2))),
+        "the fused dw kernel keeps the full bm=256 row tile (its blocks "
+        "are N-tiled, never full-N) with untiled K — one x read per "
+        "(N-tile, row-tile) pair, the halved-x-traffic design point",
+    ),
+    VmemCheck(
+        "gmm-fused-bwd-plans-everywhere",
+        lambda: all(
+            gm._fused_bwd_plan(256, n, k, 2) is not None
+            for (n, k) in ((3072, 768), (8192, 2048), (10240, 2560))
+        ),
+        "every shipped gmm config (headline + the E32 bench_moe cells) "
+        "takes the fused path — the unfused fallback is for adversarial "
+        "shapes only, so chip numbers always measure the fused kernels",
+    ),
+    VmemCheck(
         "tiled-bwd-picker-pinned",
         lambda: fa._pick_group_tiled_bwd(768, 512, 512, 64, 2, True) == 2,
         "the tiled-bwd group picker's 512-tile fused-rope decision (g=2) "
@@ -226,6 +263,10 @@ def estimate_report() -> list[tuple[str, float]]:
          fa.fused_bwd_vmem_bytes(1024, 64, 2)),
         ("gmm fused-w13 bm256 bn1024 k1024 bf16",
          gm.gmm_vmem_bytes(256, 1024, 1024, 2, fused_w13=True)),
+        ("gmm fused-bwd dx bm128 bk256 n3072 bf16",
+         gm.gmm_fused_dx_vmem_bytes(128, 256, 3072, 2)),
+        ("gmm fused-bwd dw bm256 bn512 bk768 bf16",
+         gm.gmm_fused_dw_vmem_bytes(256, 512, 768, 2)),
         ("decode slab g8 S=1024 w256 bf16",
          da.decode_vmem_bytes(8, 1024, 256, 2)),
     ]

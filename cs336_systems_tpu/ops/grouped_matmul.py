@@ -34,6 +34,23 @@ Design notes (v5e, Mosaic):
   revisits are consecutive — the only revisit pattern Mosaic supports.
   Accumulation is fp32 (the output buffer), cast outside; experts that
   own zero row tiles are zeroed by the ``visited`` mask in the vjp.
+- The fused-w13 BACKWARD (round 6) is TWO kernels, not five passes: the
+  SiLU·mul grads are recomputed in-register from the STORED h/g residuals
+  (the recorded round-5 negative was recomputing the h/g *matmuls*, not
+  their elementwise grads — ``_gmm13_fwd_hg_kernel``), so dh/dg never
+  round-trip HBM as [M, N] buffers and never sit in the live set (the
+  round-5 "b48 OOMs under gmm" cause). ``_gmm13_dx_kernel`` accumulates
+  dh@w1 + dg@w3 into one dx output in a single grid pass (rows innermost,
+  full-N weight slabs resident per expert — the same residency argument
+  as ``_w13_specs``; full-N dp/h/g row blocks force the row tile down to
+  128 at the headline shapes, see ``gmm_fused_dx_vmem_bytes``).
+  ``_gmm13_dw_kernel`` reads each x/dp/h/g row tile once and accumulates
+  BOTH dw1 and dw3 expert slabs (fp32, tile_first-gated init/acc). Row
+  tiles subdivide the packing's ``bm`` when VMEM demands it
+  (``_subdivide_tiles`` — sub-tiles inherit the parent tile's expert, so
+  the packing contract is untouched). Shapes whose operands cannot tile
+  under the budget fall back to the unfused chain
+  (``_gmm13_bwd_unfused``, also the A/B oracle in tests).
 """
 
 from __future__ import annotations
@@ -94,6 +111,136 @@ def gmm_vmem_bytes(bm: int, bn: int, k: int, itemsize: int,
     )
 
 
+# Soft budget the fused-backward tile pickers fill toward (the hardware
+# scoped-VMEM hard limit is 16 MB; analysis/vmem.py asserts every picked
+# configuration's ESTIMATE stays under it with the same arithmetic, so the
+# pickers and the estimators cannot drift). 14 MB mirrors the flash
+# forward's calibrated headroom for Mosaic's own spill slack.
+GMM_BWD_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def gmm_fused_dx_vmem_bytes(bm: int, bk: int, n: int, itemsize: int) -> int:
+    """Static per-grid-step VMEM estimate for the fused dx kernel
+    (``_gmm13_dx_kernel``), from the BlockSpecs/dtypes alone: three
+    double-buffered full-N row blocks (dp, h, g), both double-buffered
+    full-N weight slabs (w1, w3 — K tiled to ``bk``), the dx out block,
+    the in-register SiLU-grad staging (one fp32 temporary at a time plus
+    the two compute-dtype casts the dots consume) and the fp32 dot
+    accumulator. The full-N operand triplet is what forces the row tile
+    below the forward's bm at real widths: bm=256 × n=3072 blows the
+    scoped limit on the operand blocks alone (analysis/vmem.py pins it)."""
+    return (
+        3 * 2 * bm * n * itemsize  # dp/h/g row blocks, double-buffered
+        + 2 * 2 * n * bk * itemsize  # w1/w3 expert slabs, double-buffered
+        + 2 * bm * bk * itemsize  # dx out block, double-buffered
+        + bm * n * 4 + 2 * bm * n * itemsize  # silu-grad fp32 temp + casts
+        + bm * bk * 4  # fp32 dot accumulator
+    )
+
+
+def gmm_fused_dw_vmem_bytes(bm: int, bn: int, bk: int, itemsize: int) -> int:
+    """Static per-grid-step VMEM estimate for the fused dw kernel
+    (``_gmm13_dw_kernel``): dp/h/g blocks tiled to ``bn`` (the dw kernels
+    never need full-N rows), the x block, BOTH fp32 dw out blocks, the
+    SiLU-grad staging and one fp32 contribution (the kernel accumulates
+    dw1 before computing the dw3 contribution so only one is live)."""
+    return (
+        3 * 2 * bm * bn * itemsize  # dp/h/g blocks, double-buffered
+        + 2 * bm * bk * itemsize  # x block, double-buffered
+        + 2 * 2 * bn * bk * 4  # dw1/dw3 fp32 out blocks, double-buffered
+        + bm * bn * 4 + 2 * bm * bn * itemsize  # silu-grad fp32 temp + casts
+        + bn * bk * 4  # fp32 contribution, one live at a time
+    )
+
+
+def _tile_candidates(full: int) -> list[int]:
+    """Dividing 128-lane-multiple tiles of ``full`` (whole dim if none —
+    the sub-128 interpret-mode test shapes)."""
+    cands = [t for t in range(128, full + 1, 128) if full % t == 0]
+    return cands or [full]
+
+
+def _row_candidates(bm: int) -> list[int]:
+    """Dividing 8-sublane-multiple row tiles of the packing's ``bm`` —
+    every sub-tile of a bm tile belongs to the same expert, so the
+    backward may run any of these without touching the packing."""
+    cands = [t for t in range(8, bm + 1, 8) if bm % t == 0]
+    return cands or [bm]
+
+
+def _pick_dx_tiles(bm: int, n: int, k: int, itemsize: int) -> tuple[int, int]:
+    """(row tile, K tile) for the fused dx kernel: maximize the per-step
+    MXU block (bm·bk — grid steps cost ~2 us of Mosaic overhead each, the
+    flash 1024-tile lesson), tie-broken toward the larger K tile (each
+    K pass re-reads the full dp/h/g triplet from HBM). Raises when not
+    even the smallest blocks fit (the caller falls back to the unfused
+    chain)."""
+    best = None
+    for bk in _tile_candidates(k):
+        for bm_b in _row_candidates(bm):
+            if gmm_fused_dx_vmem_bytes(bm_b, bk, n, itemsize) > GMM_BWD_VMEM_BUDGET:
+                continue
+            key = (bm_b * bk, bk)
+            if best is None or key > best[0]:
+                best = (key, (bm_b, bk))
+    if best is None:
+        raise ValueError(
+            f"fused dx kernel cannot tile [bm<={bm}] x N={n} x K={k} "
+            f"(itemsize {itemsize}) under the VMEM budget")
+    return best[1]
+
+
+def _pick_dw_tiles(bm: int, n: int, k: int,
+                   itemsize: int) -> tuple[int, int, int]:
+    """(row tile, N tile, K tile) for the fused dw kernel — same scoring
+    as ``_pick_dx_tiles`` (biggest per-step block, then the larger K tile:
+    K passes re-read dp/h/g, N passes only re-read the small x block),
+    then the larger row tile."""
+    best = None
+    for bk in _tile_candidates(k):
+        for bn in _tile_candidates(n):
+            for bm_b in _row_candidates(bm):
+                if (gmm_fused_dw_vmem_bytes(bm_b, bn, bk, itemsize)
+                        > GMM_BWD_VMEM_BUDGET):
+                    continue
+                key = (bm_b * bn * bk, bk, bm_b)
+                if best is None or key > best[0]:
+                    best = (key, (bm_b, bn, bk))
+    if best is None:
+        raise ValueError(
+            f"fused dw kernel cannot tile [bm<={bm}] x N={n} x K={k} "
+            f"(itemsize {itemsize}) under the VMEM budget")
+    return best[1]
+
+
+def _fused_bwd_plan(bm: int, n: int, k: int, itemsize: int):
+    """Tile plan ((bm_dx, bk_dx), (bm_dw, bn_dw, bk_dw)) for the fused
+    w13 backward, or None when some block set cannot fit the budget (the
+    unfused-chain fallback — exercised only by adversarial shapes; every
+    shipped config plans successfully, analysis/vmem.py pins the picks)."""
+    try:
+        return (_pick_dx_tiles(bm, n, k, itemsize),
+                _pick_dw_tiles(bm, n, k, itemsize))
+    except ValueError:
+        return None
+
+
+def _subdivide_tiles(tile_expert, tile_first, factor: int):
+    """Split each packing row tile into ``factor`` sub-tiles for a
+    backward kernel running a smaller row tile than the packing's bm:
+    sub-tiles inherit the parent's expert; only the first sub-tile of an
+    expert's first tile keeps first=1 (the dw init/acc gate)."""
+    if factor == 1:
+        return tile_expert, tile_first
+    te = jnp.repeat(tile_expert, factor)
+    first = jnp.where(
+        jnp.arange(te.shape[0], dtype=jnp.int32) % factor == 0,
+        jnp.repeat(tile_first, factor),
+        0,
+    ).astype(tile_first.dtype)
+    return te, first
+
+
 def _gmm_fwd_kernel(te_ref, x_ref, w_ref, y_ref):
     del te_ref
     # y[m, o] = x[m, i] · w[o, i] — contract the shared K dim
@@ -147,6 +294,138 @@ def _silu_mul_grads(h, g, dp):
     dh = dp * g * (sig + silu * (1.0 - sig))
     dg = dp * silu
     return dh, dg
+
+
+def _silu_grads_cast(dp, h, g, dtype):
+    """In-kernel dh/dg staging shared by the two fused backward kernels:
+    fp32 grads from the stored residuals (``_silu_mul_grads``, the same
+    math the XLA pass ran), rounded to the compute dtype exactly where
+    the unfused chain rounded before its dx/dw kernel calls — keeping the
+    fused and unfused backwards aligned at test tolerance."""
+    dh32, dg32 = _silu_mul_grads(
+        h.astype(jnp.float32), g.astype(jnp.float32), dp.astype(jnp.float32))
+    return dh32.astype(dtype), dg32.astype(dtype)
+
+
+def _gmm13_dx_kernel(te_ref, dp_ref, h_ref, g_ref, w1_ref, w3_ref, dx_ref):
+    del te_ref
+    # dh/dg in-register from the stored residuals — never written to HBM
+    dh, dg = _silu_grads_cast(dp_ref[:], h_ref[:], g_ref[:], dp_ref.dtype)
+    # dx[m, i] = dh[m, o]·w1[o, i] + dg[m, o]·w3[o, i] — both halves
+    # accumulate in fp32 and round ONCE (the unfused chain's separate fp32
+    # add of two bf16-rounded dx halves is gone)
+    acc = jax.lax.dot_general(
+        dh, w1_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc = acc + jax.lax.dot_general(
+        dg, w3_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dx_ref[:] = acc.astype(dx_ref.dtype)
+
+
+def _gmm13_dw_kernel(te_ref, first_ref, dp_ref, h_ref, g_ref, x_ref,
+                     dw1_ref, dw3_ref):
+    i = pl.program_id(2)  # grid (jn, jk, i) — row tiles innermost
+    dh, dg = _silu_grads_cast(dp_ref[:], h_ref[:], g_ref[:], dp_ref.dtype)
+    # accumulate dw1 before computing the dw3 contribution so only one
+    # fp32 [bn, bk] contribution is live (the VMEM estimate counts one)
+    c1 = jax.lax.dot_general(
+        dh, x_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(first_ref[i] == 1)
+    def _init1():
+        dw1_ref[:] = c1
+
+    @pl.when(first_ref[i] == 0)
+    def _acc1():
+        dw1_ref[:] = dw1_ref[:] + c1
+
+    c3 = jax.lax.dot_general(
+        dg, x_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(first_ref[i] == 1)
+    def _init3():
+        dw3_ref[:] = c3
+
+    @pl.when(first_ref[i] == 0)
+    def _acc3():
+        dw3_ref[:] = dw3_ref[:] + c3
+
+
+def _dx13_call(dp, h, g, w1, w3, tile_expert, bm, tiles, interpret):
+    """Fused dx: one grid pass over (K tiles, row tiles — rows innermost,
+    weight slabs re-DMA only at expert/K-tile boundaries). ``tiles`` is
+    ``_pick_dx_tiles``'s (row tile, K tile)."""
+    m = dp.shape[0]
+    e, n, k = w1.shape
+    bm_b, bk = tiles
+    te, _ = _subdivide_tiles(tile_expert, tile_expert, bm // bm_b)
+    return pl.pallas_call(
+        _gmm13_dx_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(k // bk, m // bm_b),
+            in_specs=[
+                pl.BlockSpec((bm_b, n), lambda j, i, te: (i, 0)),  # dp
+                pl.BlockSpec((bm_b, n), lambda j, i, te: (i, 0)),  # h
+                pl.BlockSpec((bm_b, n), lambda j, i, te: (i, 0)),  # g
+                # full out rows of one expert, K tiled — fold
+                # [E, N, K] -> [E·N, K] and step N-block rows per e
+                pl.BlockSpec((n, bk), lambda j, i, te: (te[i], j)),
+                pl.BlockSpec((n, bk), lambda j, i, te: (te[i], j)),
+            ],
+            out_specs=pl.BlockSpec((bm_b, bk), lambda j, i, te: (i, j)),
+        ),
+        out_shape=_out_sds((m, k), dp.dtype, dp, w1),
+        interpret=interpret,
+    )(te, dp, h, g, w1.reshape(e * n, k), w3.reshape(e * n, k))
+
+
+def _dw13_call(dp, h, g, x, w1, tile_expert, tile_first, visited, bm,
+               tiles, interpret):
+    """Fused dw: each x/dp/h/g row tile is read once per (N, K) out tile
+    and contributes to BOTH dw1 and dw3 expert slabs (fp32 accumulation
+    over consecutive same-expert row tiles, tile_first-gated init/acc as
+    the unfused dw). Returns fp32 (e, n, k) pairs with never-visited
+    experts zeroed; the caller casts."""
+    m, k = x.shape
+    e, n, _ = w1.shape
+    bm_b, bn, bk = tiles
+    te, first = _subdivide_tiles(tile_expert, tile_first, bm // bm_b)
+    nb = n // bn
+    dw1, dw3 = pl.pallas_call(
+        _gmm13_dw_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nb, k // bk, m // bm_b),
+            in_specs=[
+                pl.BlockSpec((bm_b, bn), lambda jn, jk, i, te, fi: (i, jn)),
+                pl.BlockSpec((bm_b, bn), lambda jn, jk, i, te, fi: (i, jn)),
+                pl.BlockSpec((bm_b, bn), lambda jn, jk, i, te, fi: (i, jn)),
+                pl.BlockSpec((bm_b, bk), lambda jn, jk, i, te, fi: (i, jk)),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (bn, bk),
+                    lambda jn, jk, i, te, fi, nb=nb: (te[i] * nb + jn, jk),
+                )
+                for _ in range(2)
+            ],
+        ),
+        out_shape=[_out_sds((e * n, k), jnp.float32, dp, x)
+                   for _ in range(2)],
+        interpret=interpret,
+    )(te, first, dp, h, g, x)
+    mask = visited.astype(bool)[:, None, None]
+    dw1 = jnp.where(mask, dw1.reshape(e, n, k), 0)
+    dw3 = jnp.where(mask, dw3.reshape(e, n, k), 0)
+    return dw1, dw3
 
 
 def _gmm13_fwd_kernel(te_ref, x_ref, w1_ref, w3_ref, p_ref):
@@ -405,9 +684,11 @@ def grouped_matmul_w13(x, w1, w3, tile_expert, tile_first, visited,
     the TRAINING forward (the vjp-fwd variant) additionally writes h
     and g as the silu·mul backward's residuals — storing them measures
     ~5x cheaper than recomputing at these shapes (see
-    ``_gmm13_fwd_hg_kernel``). The backward is XLA elementwise dh/dg
-    from the stored h/g plus the SHARED grouped dx/dw kernels
-    (``_dx_call``/``_dw_call``) per weight.
+    ``_gmm13_fwd_hg_kernel``). The backward is TWO fused kernels
+    (``_gmm13_dx_kernel``/``_gmm13_dw_kernel``, round 6): dh/dg are
+    recomputed in-register from the stored h/g so they never round-trip
+    HBM; shapes whose blocks cannot tile under the VMEM budget fall back
+    to the five-pass ``_gmm13_bwd_unfused`` chain.
 
     Same contracts as ``grouped_matmul`` (rows grouped by expert, bm
     tiles, native [E, N, K] weight layout, ``tile_maps`` operands);
@@ -458,14 +739,14 @@ def _gmm13_fwd(x, w1, w3, tile_expert, tile_first, visited, bm, interpret):
     return p, (x, w1, w3, h, g, tile_expert, tile_first, visited)
 
 
-def _gmm13_bwd(bm, interpret, res, dp):
+def _gmm13_bwd_unfused(bm, interpret, res, dp):
+    """The pre-round-6 five-pass backward: XLA ``_silu_mul_grads``
+    materializing dh/dg in HBM, then 2× ``_dx_call`` + an fp32 dx add and
+    2× ``_dw_call`` each re-reading x. Kept as (a) the fallback for shapes
+    whose fused operand blocks cannot tile under ``GMM_BWD_VMEM_BUDGET``
+    and (b) the A/B oracle the fused-path parity tests compare against."""
     x, w1, w3, h, g, tile_expert, tile_first, visited = res
-    interpret_r = _resolve_interpret(interpret)
-    m, k = x.shape
-    e, n, _ = w1.shape
 
-    # dh/dg from the STORED residuals — one elementwise pass XLA fuses
-    # (the compute-dtype staging matches the unfused path's autodiff)
     dh32, dg32 = _silu_mul_grads(
         h.astype(jnp.float32), g.astype(jnp.float32),
         dp.astype(jnp.float32),
@@ -473,7 +754,30 @@ def _gmm13_bwd(bm, interpret, res, dp):
     dh = dh32.astype(dp.dtype)
     dg = dg32.astype(dp.dtype)
 
+    dx = (_dx_call(dh, w1, tile_expert, bm, interpret).astype(jnp.float32)
+          + _dx_call(dg, w3, tile_expert, bm, interpret)).astype(dp.dtype)
+    dw1 = _dw_call(dh, x, w1, tile_expert, tile_first, visited, bm,
+                   interpret)
+    dw3 = _dw_call(dg, x, w3, tile_expert, tile_first, visited, bm,
+                   interpret)
+    return (dx, dw1, dw3,
+            float0_like(tile_expert), float0_like(tile_first),
+            float0_like(visited))
+
+
+def _gmm13_bwd(bm, interpret, res, dp):
+    x, w1, w3, h, g, tile_expert, tile_first, visited = res
+    interpret_r = _resolve_interpret(interpret)
+    m, k = x.shape
+    e, n, _ = w1.shape
+
     if interpret_r and _vma_varying(x, w1, w3, dp, tile_expert):
+        # dh/dg staging matches the kernels: fp32 grads from the stored
+        # residuals, dx rounded once from the fp32 two-dot sum
+        dh32, dg32 = _silu_mul_grads(
+            h.astype(jnp.float32), g.astype(jnp.float32),
+            dp.astype(jnp.float32),
+        )
         onehot = _row_onehot(tile_expert, bm, m, e, jnp.float32)
         x32 = x.astype(jnp.float32)
         dx = (jnp.einsum("me,mn,enk->mk", onehot, dh32,
@@ -488,13 +792,15 @@ def _gmm13_bwd(bm, interpret, res, dp):
                 float0_like(tile_expert), float0_like(tile_first),
                 float0_like(visited))
 
-    dx = (_dx_call(dh, w1, tile_expert, bm, interpret_r).astype(jnp.float32)
-          + _dx_call(dg, w3, tile_expert, bm, interpret_r)).astype(dp.dtype)
-    dw1 = _dw_call(dh, x, w1, tile_expert, tile_first, visited, bm,
-                   interpret_r)
-    dw3 = _dw_call(dg, x, w3, tile_expert, tile_first, visited, bm,
-                   interpret_r)
-    return (dx, dw1, dw3,
+    plan = _fused_bwd_plan(bm, n, k, w1.dtype.itemsize)
+    if plan is None:
+        return _gmm13_bwd_unfused(bm, interpret_r, res, dp)
+    dx_tiles, dw_tiles = plan
+    dx = _dx13_call(dp, h, g, w1, w3, tile_expert, bm, dx_tiles,
+                    interpret_r)
+    dw1, dw3 = _dw13_call(dp, h, g, x, w1, tile_expert, tile_first,
+                          visited, bm, dw_tiles, interpret_r)
+    return (dx, dw1.astype(w1.dtype), dw3.astype(w3.dtype),
             float0_like(tile_expert), float0_like(tile_first),
             float0_like(visited))
 

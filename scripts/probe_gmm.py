@@ -19,6 +19,14 @@ were exact).
 
 Verdict recorded in results/moe_v5e.txt; the kernel is promoted to
 ops/ only if it wins.
+
+`--bwd` (round 6) probes the w13 BACKWARD kernels in isolation at the
+E8k2 b40 geometry (M=43008 packed rows): the fused one-pass dx and dw
+kernels (`ops/grouped_matmul._dx13_call`/`_dw13_call`, SiLU grads
+in-register from the stored h/g residuals) against the retained five-pass
+unfused chain — the attribution behind BASELINE.md's "exec 80.3 vs 92.9
+TF/s, the dx/dw bwd kernels" open item, reproducible before/after. Same
+timing discipline as the forward probe: in-jit chained loops, one fence.
 """
 
 import argparse
@@ -150,13 +158,113 @@ def bench(bm: int, bn: int, iters: int = 600):
               f"{eff * 100:5.1f}% useful-FLOP MFU")
 
 
+def _bwd_case(e, k, n, bm, tiles_per_e, dtype=jnp.bfloat16):
+    """Packed backward operands at a uniform claims-per-expert layout."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    m = e * tiles_per_e * bm
+    keys = jax.random.split(jax.random.PRNGKey(3), 6)
+    x = jax.random.normal(keys[0], (m, k), dtype)
+    w1 = jax.random.normal(keys[1], (e, n, k), dtype)
+    w3 = jax.random.normal(keys[2], (e, n, k), dtype)
+    h = jax.random.normal(keys[3], (m, n), dtype)
+    g = jax.random.normal(keys[4], (m, n), dtype)
+    dp = jax.random.normal(keys[5], (m, n), dtype)
+    te = jnp.asarray(np.repeat(np.arange(e), tiles_per_e).astype(np.int32))
+    first = jnp.asarray(
+        (np.arange(e * tiles_per_e) % tiles_per_e == 0).astype(np.int32))
+    visited = jnp.ones((e,), jnp.int32)
+    res = (x, w1, w3, h, g, te, first, visited)
+    return gm, m, res, dp
+
+
+def check_bwd_correctness():
+    """Interpret-mode: the fused dx/dw kernels match the unfused chain
+    (the ops-level oracle tests carry the einsum comparison)."""
+    gm, _, res, dp = _bwd_case(4, 32, 64, 8, 3, jnp.float32)
+    fused = gm._gmm13_bwd(8, True, res, dp)[:3]
+    unfused = gm._gmm13_bwd_unfused(8, True, res, dp)[:3]
+    for a, b, name in zip(fused, unfused, ("dx", "dw1", "dw3")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+    print("fused-bwd interpret-mode parity vs unfused chain OK")
+
+
+def bench_bwd(iters: int = 100):
+    """dx/dw backward kernels in isolation at the E8k2 b40 shapes
+    (M = 8 experts × 21 tiles × bm=256 = 43008 packed rows ≈ the 40960
+    routed claims + tile padding). Each row is an in-jit chained loop
+    fenced once; executed TF/s uses each pass's own 4·M·N·K FLOPs."""
+    from bench import V5E_BF16_PEAK_FLOPS
+
+    gm, m, res, dp = _bwd_case(e=8, k=768, n=3072, bm=256, tiles_per_e=21)
+    x, w1, w3, h, g, te, first, visited = res
+    bm, k, n = 256, 768, 3072
+    plan = gm._fused_bwd_plan(bm, n, k, w1.dtype.itemsize)
+    assert plan is not None, "headline shapes must take the fused path"
+    dx_tiles, dw_tiles = plan
+    print(f"fused plan: dx (bm, bk) = {dx_tiles}, "
+          f"dw (bm, bn, bk) = {dw_tiles}")
+    eps = jnp.bfloat16(1e-3)
+
+    def chained(step_fn):
+        @jax.jit
+        def loop(dpc):
+            def body(dpc, _):
+                return step_fn(dpc), None
+            out, _ = jax.lax.scan(body, dpc, None, length=iters)
+            return out
+        return loop
+
+    def fused_dx(dpc):
+        dx = gm._dx13_call(dpc, h, g, w1, w3, te, bm, dx_tiles, False)
+        return dpc + eps * dx[:, :1]  # chain or the body hoists
+
+    def fused_dw(dpc):
+        dw1, dw3 = gm._dw13_call(dpc, h, g, x, w1, te, first, visited,
+                                 bm, dw_tiles, False)
+        return dpc + eps * (dw1[0, 0, 0] + dw3[0, 0, 0]).astype(dpc.dtype)
+
+    def fused_total(dpc):
+        dx, dw1, dw3 = gm._gmm13_bwd(bm, False, res, dpc)[:3]
+        return (dpc + eps * dx[:, :1]
+                + eps * (dw1[0, 0, 0] + dw3[0, 0, 0]).astype(dpc.dtype))
+
+    def unfused_total(dpc):
+        dx, dw1, dw3 = gm._gmm13_bwd_unfused(bm, False, res, dpc)[:3]
+        return (dpc + eps * dx[:, :1]
+                + eps * (dw1[0, 0, 0] + dw3[0, 0, 0]).astype(dpc.dtype))
+
+    pass_flops = 4 * m * n * k  # two [M,N]x[N,K]-class dots per pass
+    for name, fn, flops in [
+        ("fused dx (one pass)", fused_dx, pass_flops),
+        ("fused dw (one pass)", fused_dw, pass_flops),
+        ("fused bwd total", fused_total, 2 * pass_flops),
+        ("unfused 5-pass bwd total", unfused_total, 2 * pass_flops),
+    ]:
+        result, _ = timed_total(chained(fn), dp, warmup=1, iters=2)
+        ms = result.min_ms / iters
+        tf = flops / (ms / 1e3) / 1e12
+        print(f"{name:28s} {ms:8.3f} ms/call  {tf:6.1f} TF/s executed  "
+              f"{tf * 1e12 / V5E_BF16_PEAK_FLOPS * 100:5.1f}% MFU")
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--bm", type=int, default=512)
     p.add_argument("--bn", type=int, default=1024)
     p.add_argument("--check", action="store_true")
+    p.add_argument("--bwd", action="store_true",
+                   help="probe the fused w13 backward kernels instead")
     args = p.parse_args()
-    if args.check or jax.default_backend() != "tpu":
-        check_correctness()
-    if jax.default_backend() == "tpu":
-        bench(args.bm, args.bn)
+    on_tpu = jax.default_backend() == "tpu"
+    if args.bwd:
+        if args.check or not on_tpu:
+            check_bwd_correctness()
+        if on_tpu:
+            bench_bwd()
+    else:
+        if args.check or not on_tpu:
+            check_correctness()
+        if on_tpu:
+            bench(args.bm, args.bn)

@@ -174,6 +174,79 @@ def test_bf16_big_dot_and_small_fp32_dot_pass():
     assert contracts.check_no_big_fp32_dots("t", jaxpr) == []
 
 
+# --- gmm fused backward -----------------------------------------------------
+
+
+def _trace_w13_bwd(bwd_fn):
+    """Trace a w13-backward implementation at the registry's headline-like
+    geometry (the shapes where the fused plan subdivides the row tile)."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    bm, e, n, k = 256, 8, 3072, 768
+    m = e * bm
+    bf16 = jnp.bfloat16
+    x = jax.ShapeDtypeStruct((m, k), bf16)
+    w = jax.ShapeDtypeStruct((e, n, k), bf16)
+    rows = jax.ShapeDtypeStruct((m, n), bf16)
+    ti = jax.ShapeDtypeStruct((m // bm,), jnp.int32)
+    ve = jax.ShapeDtypeStruct((e,), jnp.int32)
+
+    def fn(x, w1, w3, h, g, te, first, visited, dp):
+        res = (x, w1, w3, h, g, te, first, visited)
+        return bwd_fn(bm, True, res, dp)[:3]
+
+    return jax.make_jaxpr(fn)(x, w, w, rows, rows, ti, ti, ve, rows)
+
+
+def test_gmm_fused_bwd_contract_clean():
+    """The shipped fused backward is <= 2 pallas_calls with the SiLU grads
+    in-register — the registered gmm_fused_bwd step must lint clean."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    jaxpr = _trace_w13_bwd(gm._gmm13_bwd)
+    assert contracts.check_gmm_fused_bwd("t", jaxpr) == []
+    assert jaxpr_scan.count_prim(jaxpr, "pallas_call") == 2
+
+
+def test_gmm_unfused_bwd_flagged():
+    """The pre-round-6 five-pass chain (the retained fallback) is the
+    known-bad program: 4 pallas_calls AND a host-program logistic — BOTH
+    diagnostics must fire."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    jaxpr = _trace_w13_bwd(gm._gmm13_bwd_unfused)
+    vs = contracts.check_gmm_fused_bwd("t", jaxpr)
+    assert _rules(vs) == {"gmm-fused-bwd"}
+    msgs = " ".join(v.message for v in vs)
+    assert "pallas_calls" in msgs and "logistic" in msgs
+    assert len(vs) == 2
+
+
+def test_gmm_fused_bwd_budget_edit_falls_back_and_is_flagged(monkeypatch):
+    """Starving the fused-bwd budget makes the planner fall back to the
+    unfused chain (correctness preserved) — and the contract catches the
+    silent perf regression, plus the pinned-picker vmem check."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    monkeypatch.setattr(gm, "GMM_BWD_VMEM_BUDGET", 64 * 1024)
+    assert gm._fused_bwd_plan(256, 3072, 768, 2) is None
+    jaxpr = _trace_w13_bwd(gm._gmm13_bwd)
+    assert "gmm-fused-bwd" in _rules(contracts.check_gmm_fused_bwd("t", jaxpr))
+    assert {"gmm-fused-dx-picked-fits", "gmm-fused-dw-picked-fits",
+            "gmm-fused-bwd-plans-everywhere"} <= {
+                v.where for v in vmem.run_vmem_checks()}
+
+
+def test_gmm_fused_dx_full_bm_rejected():
+    """The estimator must reject the full-bm=256 dx row tile the VMEM
+    arithmetic rules out (the reason _subdivide_tiles exists)."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    assert gm.gmm_fused_dx_vmem_bytes(256, 256, 3072, 2) > vmem.SCOPED_VMEM_LIMIT
+    bm_b, _ = gm._pick_dx_tiles(256, 3072, 768, 2)
+    assert bm_b < 256
+
+
 # --- VMEM budget facts ------------------------------------------------------
 
 

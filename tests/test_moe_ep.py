@@ -568,6 +568,115 @@ def test_gmm_w13_fused_matches_unfused_chain():
                                    rtol=1e-4, atol=1e-4, err_msg=name)
 
 
+def _w13_bwd_case(key, d, f, e, bm, counts, spare_tiles=2):
+    """Packed operands + vjp residuals + a random cotangent for backward
+    parity tests: NON-divisible counts (pad rows inside tiles), an empty
+    expert, and spare tail tiles past the last group."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    n_tiles = int(jnp.sum((counts + bm - 1) // bm)) + spare_tiles
+    m_pad = n_tiles * bm
+    te, first, visited, starts = gm.tile_maps(counts, bm, n_tiles)
+    kx, k1, k3, kd = jax.random.split(key, 4)
+    x = jnp.zeros((m_pad, d))
+    for g, c in enumerate(np.asarray(counts)):
+        s = int(starts[g])
+        x = x.at[s:s + int(c)].set(
+            jax.random.normal(jax.random.fold_in(kx, g), (int(c), d)))
+    w1 = jax.random.normal(k1, (e, f, d)) * 0.3
+    w3 = jax.random.normal(k3, (e, f, d)) * 0.3
+    _, res = gm._gmm13_fwd(x, w1, w3, te, first, visited, bm, True)
+    dp = jax.random.normal(kd, (m_pad, f))
+    return res, dp, (te, visited)
+
+
+def test_gmm13_fused_bwd_three_way_parity():
+    """The round-6 fused backward (TWO Pallas kernels, SiLU grads
+    in-register) == the retained five-pass unfused chain == the einsum
+    oracle — dx, dw1, dw3, with non-divisible counts, pad rows inside
+    tiles, and an EMPTY expert whose dw must stay exactly zero."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    d, f, e, bm = 16, 32, 4, 8
+    counts = jnp.asarray([9, 0, 13, 3], jnp.int32)  # none divides bm
+    res, dp, (te, visited) = _w13_bwd_case(
+        jax.random.PRNGKey(11), d, f, e, bm, counts)
+    x, w1, w3 = res[0], res[1], res[2]
+
+    assert gm._fused_bwd_plan(bm, f, d, x.dtype.itemsize) is not None
+    fused = gm._gmm13_bwd(bm, True, res, dp)[:3]
+    unfused = gm._gmm13_bwd_unfused(bm, True, res, dp)[:3]
+
+    # kernel chain vs kernel chain: same staging, near-identical in f32
+    for a, b, name in zip(fused, unfused, ("dx", "dw1", "dw3")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+    # einsum oracle (pad rows have x = 0, so their SiLU grads vanish and
+    # the full-array comparison is exact-by-contract)
+    m_pad = x.shape[0]
+    onehot = gm._row_onehot(te, bm, m_pad, e, jnp.float32)
+
+    def ref(x, w1, w3):
+        h = jnp.einsum("me,mk,enk->mn", onehot, x, w1)
+        g = jnp.einsum("me,mk,enk->mn", onehot, x, w3)
+        return jax.nn.silu(h) * g
+
+    _, vjp = jax.vjp(ref, x, w1, w3)
+    oracle = vjp(dp)
+    for a, b, name in zip(fused, oracle, ("dx", "dw1", "dw3")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+    # expert 1 owns zero tiles: its dw slabs are EXACTLY zero (the
+    # visited mask, not just small numbers)
+    assert int(visited[1]) == 0
+    assert np.all(np.asarray(fused[1][1]) == 0)
+    assert np.all(np.asarray(fused[2][1]) == 0)
+
+
+def test_gmm13_fused_bwd_row_subdivision(monkeypatch):
+    """Starving the bwd VMEM budget makes the pickers subdivide the
+    packing's row tile (the headline-shape regime, where full-N operand
+    blocks at bm=256 blow scoped VMEM) — sub-tiles inherit the parent's
+    expert and the grads still match the unfused chain."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    d, f, e, bm = 16, 32, 4, 16
+    counts = jnp.asarray([9, 0, 13, 3], jnp.int32)
+    res, dp, _ = _w13_bwd_case(
+        jax.random.PRNGKey(13), d, f, e, bm, counts)
+
+    monkeypatch.setattr(gm, "GMM_BWD_VMEM_BUDGET", 25_000)
+    bm_dx, _ = gm._pick_dx_tiles(bm, f, d, 4)
+    bm_dw, _, _ = gm._pick_dw_tiles(bm, f, d, 4)
+    assert bm_dx < bm and bm_dw < bm  # the subdivision actually engages
+
+    fused = gm._gmm13_bwd(bm, True, res, dp)[:3]
+    unfused = gm._gmm13_bwd_unfused(bm, True, res, dp)[:3]
+    for a, b, name in zip(fused, unfused, ("dx", "dw1", "dw3")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_gmm13_fused_bwd_unfused_fallback(monkeypatch):
+    """A budget no block set can satisfy must fall back to the unfused
+    chain (plan None) — correctness preserved, never an exception."""
+    from cs336_systems_tpu.ops import grouped_matmul as gm
+
+    d, f, e, bm = 16, 32, 4, 8
+    counts = jnp.asarray([9, 0, 13, 3], jnp.int32)
+    res, dp, (te, visited) = _w13_bwd_case(
+        jax.random.PRNGKey(17), d, f, e, bm, counts)
+
+    monkeypatch.setattr(gm, "GMM_BWD_VMEM_BUDGET", 64)
+    assert gm._fused_bwd_plan(bm, f, d, 4) is None
+    out = gm._gmm13_bwd(bm, True, res, dp)[:3]
+    ref = gm._gmm13_bwd_unfused(bm, True, res, dp)[:3]
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
 def test_ep_a2a_uneven_split_direction():
     """{dp:4, ep:2} — more dp than ep (the transpose of the main oracle
     mesh): two local experts per shard, fill order over 8 token shards."""
