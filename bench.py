@@ -30,34 +30,15 @@ honor_cpu_request()
 import jax
 import jax.numpy as jnp
 
+# Canonical implementations live in the package (analysis/flops.py) so
+# tracekit shares the same MFU denominator; re-exported here because
+# scripts/bench_moe.py and the recorded artifacts cite these names.
+from cs336_systems_tpu.analysis.flops import (  # noqa: F401 — re-export
+    V5E_BF16_PEAK_FLOPS,
+    model_flops_per_token,
+)
+
 BASELINE_TOKENS_PER_SEC = 1.0e5  # analytic A100 eager-reference estimate
-V5E_BF16_PEAK_FLOPS = 197e12  # v5litepod chip peak, bf16
-
-
-def model_flops_per_token(cfg, causal: bool = True) -> float:
-    """Analytic matmul FLOPs per trained token (fwd + bwd = 3× fwd).
-
-    6·N_matmul for the parameter matmuls (attention projections, SwiGLU,
-    LM head; the embedding lookup is not a matmul) plus the attention
-    score/value matmuls — 12·S·d_model per layer per token full, halved
-    under causal masking: the standard model-FLOPs MFU convention counts
-    only the causal lower triangle. (NOTE: this is a convention, not a
-    claim about the kernels — at the headline shape S=512 with 512-tiles
-    the single k-tile straddles the diagonal, so the hardware executes the
-    full S×S tile; conventional MFU understates utilization there.)
-    """
-    d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
-    s = cfg.context_length
-    # MoE configs: a token's FFN work is its top-k experts (plus the
-    # router matmul); inactive experts do no model FLOPs for it.
-    e = getattr(cfg, "num_experts", 0)
-    ffn_mult = max(getattr(cfg, "moe_top_k", 1), 1) if e else 1
-    n_matmul = (
-        L * (4 * d * d + ffn_mult * 3 * d * dff + d * e)
-        + d * cfg.vocab_size
-    )
-    attn = 12 * s * d * L * (0.5 if causal else 1.0)
-    return 6 * n_matmul + attn
 
 
 def main() -> None:
@@ -117,6 +98,26 @@ def main() -> None:
         line["mfu"] = round(
             tokens_per_sec * flops_per_token / V5E_BF16_PEAK_FLOPS, 3
         )
+    try:
+        # device-lane truth for the same loop (analysis/tracekit): wall
+        # carries the dispatch path, the trace does not — when both exist
+        # the trace-sourced mfu wins. Any failure leaves the wall line
+        # intact (the ONE-JSON-line contract).
+        from cs336_systems_tpu.analysis import tracekit
+        from cs336_systems_tpu.train import make_train_loop as _mtl
+
+        prof = tracekit.profile_callable(
+            _mtl(cfg, AdamWHparams(lr=3e-4), donate=False),  # re-callable
+            (params, opt_state, xs, ys), iters=1,
+            tokens_per_step=batch * ctx * timed,
+            flops_per_token=flops_per_token,
+            family="headline_loop",
+        )
+        line["device_ms_per_step"] = round(
+            prof["total_device_ms_per_step"] / timed, 2)
+        line["mfu"] = prof["mfu"]
+    except Exception:  # noqa: BLE001 — telemetry is additive, never fatal
+        pass
     print(json.dumps(line))
 
 

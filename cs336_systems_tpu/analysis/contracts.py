@@ -151,6 +151,36 @@ def check_gmm_fused_bwd(name: str, jaxpr,
     return out
 
 
+def check_phase_scopes(name: str, jaxpr, expected) -> list[Violation]:
+    """Every marker in ``expected`` must appear in some equation's
+    ``named_scope`` stack (``eqn.source_info.name_stack``).
+
+    This is what keeps analysis/tracekit's phase attribution from silently
+    rotting: the phase breakdown joins trace ops to phases through the
+    scope names threaded into models/transformer.py, models/decode.py,
+    models/moe.py and train.make_update_fn — if a refactor drops one, the
+    profiles keep printing, just with that phase's time absorbed into
+    "other". Markers are substrings: ``"transpose("`` matches the
+    ``transpose(jvp(...))`` stack AD stamps on every backward op, so the
+    bwd phase is checked without any hand annotation."""
+    want = list(expected)
+    found: set = set()
+    for eqn in jaxpr_scan.iter_eqns(jaxpr):
+        stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+        for marker in want:
+            if marker not in found and marker in stack:
+                found.add(marker)
+        if len(found) == len(want):
+            return []
+    missing = [m for m in want if m not in found]
+    return [Violation(
+        "phase-scope", name,
+        f"no equation carries named_scope marker(s) {missing} — tracekit "
+        "would attribute that phase's device time to 'other'; restore the "
+        "annotate(...) scope (models/ or train.make_update_fn)",
+    )]
+
+
 # A dot is "big" when M, N and K are ALL at least this: the fp32 router
 # matmul ([T, D] x [D, E], E ~ 8) and the tril prefix-sum einsums pass
 # under it by design; a silently-upcast projection/FFN/attention matmul

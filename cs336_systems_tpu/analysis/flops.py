@@ -1,0 +1,64 @@
+"""Analytic model-FLOPs accounting — the shared MFU denominator.
+
+One implementation for every consumer: ``bench.py`` (the headline JSON
+line), ``scripts/bench_moe.py`` (the per-cell MoE MFU column) and
+``analysis/tracekit.py`` (the StepProfile's achieved-TF/s and MFU fields)
+all import from here, so the convention cannot drift between the artifacts
+that get compared against each other. Historically this lived in
+``bench.py``; it moved into the package so ``cs336_systems_tpu`` modules
+can use it without requiring the repo root on ``sys.path`` (``bench.py``
+re-exports the old names).
+"""
+
+from __future__ import annotations
+
+V5E_BF16_PEAK_FLOPS = 197e12  # v5litepod chip peak, bf16
+
+
+def model_flops_per_token(cfg, causal: bool = True) -> float:
+    """Analytic matmul FLOPs per trained token (fwd + bwd = 3× fwd).
+
+    6·N_matmul for the parameter matmuls (attention projections, SwiGLU,
+    LM head; the embedding lookup is not a matmul) plus the attention
+    score/value matmuls — 12·S·d_model per layer per token full, halved
+    under causal masking: the standard model-FLOPs MFU convention counts
+    only the causal lower triangle. (NOTE: this is a convention, not a
+    claim about the kernels — at the headline shape S=512 with 512-tiles
+    the single k-tile straddles the diagonal, so the hardware executes the
+    full S×S tile; conventional MFU understates utilization there.)
+    """
+    d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
+    s = cfg.context_length
+    # MoE configs: a token's FFN work is its top-k experts (plus the
+    # router matmul); inactive experts do no model FLOPs for it.
+    e = getattr(cfg, "num_experts", 0)
+    ffn_mult = max(getattr(cfg, "moe_top_k", 1), 1) if e else 1
+    n_matmul = (
+        L * (4 * d * d + ffn_mult * 3 * d * dff + d * e)
+        + d * cfg.vocab_size
+    )
+    attn = 12 * s * d * L * (0.5 if causal else 1.0)
+    return 6 * n_matmul + attn
+
+
+def decode_flops_per_token(cfg, attend_len: int | None = None) -> float:
+    """Analytic matmul FLOPs per GENERATED token in cached decoding.
+
+    Forward only (2·N_matmul for the parameter matmuls) plus the cached
+    attention's score/value dots over the attended prefix: one [1, d]
+    query against ``attend_len`` cached rows is 4·attend·d_model per layer
+    (2 FLOPs/MAC × score + value). ``attend_len`` defaults to the full
+    context window — the upper bound the bucket schedule in
+    ``models/decode._generate_scan`` approaches; callers with a known fill
+    level pass it for a tighter number. Prefill FLOPs are NOT amortized in
+    (they are a one-time cost, reported separately by the decode bench).
+    """
+    d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
+    attend = attend_len if attend_len is not None else cfg.context_length
+    e = getattr(cfg, "num_experts", 0)
+    ffn_mult = max(getattr(cfg, "moe_top_k", 1), 1) if e else 1
+    n_matmul = (
+        L * (4 * d * d + ffn_mult * 3 * d * dff + d * e)
+        + d * cfg.vocab_size
+    )
+    return 2 * n_matmul + 4 * attend * d * L

@@ -58,6 +58,9 @@ def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
         out += contracts.check_no_big_fp32_dots(name, traced.jaxpr)
     if c.get("gmm_fused_bwd"):
         out += contracts.check_gmm_fused_bwd(name, traced.jaxpr)
+    if c.get("phase_scopes"):
+        out += contracts.check_phase_scopes(name, traced.jaxpr,
+                                            c["phase_scopes"])
     return out
 
 

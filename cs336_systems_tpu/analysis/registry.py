@@ -22,6 +22,9 @@ Contract key glossary (consumed by ``lint.run``):
   bf16-compute configs — fp32 configs are fp32 on purpose).
 - ``gmm_fused_bwd``: enforce the fused-w13 backward shape (<= 2
   pallas_calls, no host-program ``logistic``).
+- ``phase_scopes``: named_scope markers that must appear in the jaxpr —
+  the annotations analysis/tracekit's phase attribution reads (dropping
+  one silently folds that phase into "other" in every profile).
 - The routing-cumsum lint always runs; no jaxpr here may carry a long
   cumsum/reduce_window.
 """
@@ -50,6 +53,15 @@ class Traced:
 class StepSpec:
     name: str
     build: Callable[[], Traced]
+
+
+# named_scope markers every training step must carry (tracekit's phase
+# attribution joins on them; "transpose(" is AD's own backward marker so
+# the bwd phase needs no hand annotation). MoE adds the router scope;
+# serving generation carries the decode-side scopes.
+TRAIN_PHASE_SCOPES = ("attn", "ffn", "optimizer", "transpose(")
+MOE_TRAIN_PHASE_SCOPES = TRAIN_PHASE_SCOPES + ("routing",)
+SERVE_PHASE_SCOPES = ("attn", "ffn", "kv_update", "sampling")
 
 
 def _tiny_cfg(**kw):
@@ -115,6 +127,7 @@ def _build_train_single() -> Traced:
     contract = {
         "collectives": {},
         "min_aliases": _n_leaves(state),
+        "phase_scopes": TRAIN_PHASE_SCOPES,
         "note": "single-device step: no mesh, no collectives; donation "
                 "must alias every param/moment leaf",
     }
@@ -135,6 +148,7 @@ def _build_train_single_bf16() -> Traced:
         "collectives": {},
         "min_aliases": _n_leaves(state),
         "check_fp32_dots": True,
+        "phase_scopes": TRAIN_PHASE_SCOPES,
         "note": "bf16 compute path: every big dot must have bf16 operands "
                 "(fp32 accumulation via preferred_element_type only)",
     }
@@ -151,6 +165,7 @@ def _build_train_moe(dispatch: str) -> Traced:
         "collectives": {},
         "min_aliases": _n_leaves(state),
         "barriers": cfg.num_layers,  # forward floor; bwd adds its own
+        "phase_scopes": MOE_TRAIN_PHASE_SCOPES,
         "note": f"single-device MoE[{dispatch}]: unrolled stack needs the "
                 "per-layer optimization_barrier; routing must be "
                 "_prefix_count (no long cumsum)",
@@ -171,7 +186,8 @@ def _build_train_dp(variant: str) -> Traced:
     step = make_dp_train_step(cfg, _hp(), make_mesh({"dp": 8}),
                               variant=variant)
     contract = dict(lint_contract(state[0], variant=variant),
-                    min_aliases=_n_leaves(state))
+                    min_aliases=_n_leaves(state),
+                    phase_scopes=TRAIN_PHASE_SCOPES)
     return _traced_train(step, state, x, y, contract)
 
 
@@ -183,7 +199,8 @@ def _build_train_tp() -> Traced:
     state = _abstract_state(cfg)
     x, y = _batch(cfg)
     step = make_tp_train_step(cfg, _hp(), make_mesh({"dp": 2, "tp": 4}))
-    contract = dict(lint_contract(), min_aliases=_n_leaves(state))
+    contract = dict(lint_contract(), min_aliases=_n_leaves(state),
+                    phase_scopes=TRAIN_PHASE_SCOPES)
     return _traced_train(step, state, x, y, contract)
 
 
@@ -197,7 +214,8 @@ def _build_train_tp_sp() -> Traced:
     x, y = _batch(cfg)
     step = make_tp_sp_train_step(
         cfg, _hp(), make_mesh({"dp": 2, "tp": 2, "sp": 2}))
-    contract = dict(lint_contract(cfg), min_aliases=_n_leaves(state))
+    contract = dict(lint_contract(cfg), min_aliases=_n_leaves(state),
+                    phase_scopes=TRAIN_PHASE_SCOPES)
     return _traced_train(step, state, x, y, contract)
 
 
@@ -210,7 +228,8 @@ def _build_train_ep_a2a() -> Traced:
     x, y = _batch(cfg)
     step = make_ep_train_step(cfg, _hp(), make_mesh({"dp": 2, "ep": 4}))
     contract = dict(lint_contract(cfg, n_token_axes=2),
-                    min_aliases=_n_leaves(state))
+                    min_aliases=_n_leaves(state),
+                    phase_scopes=MOE_TRAIN_PHASE_SCOPES)
     return _traced_train(step, state, x, y, contract)
 
 
@@ -276,8 +295,9 @@ def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
     else:
         fn = gen
     jaxpr = jax.make_jaxpr(fn)(params, ids, key)
-    contract = lint_contract(cfg, dp_axis=dp_axis, tp_axis=tp_axis,
-                             ep_axis=ep_axis)
+    contract = dict(lint_contract(cfg, dp_axis=dp_axis, tp_axis=tp_axis,
+                                  ep_axis=ep_axis),
+                    phase_scopes=SERVE_PHASE_SCOPES)
     return Traced(jaxpr, None, contract)
 
 

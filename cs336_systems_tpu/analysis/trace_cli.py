@@ -1,0 +1,107 @@
+"""trace_cli: phase-attributed device telemetry for any registered step.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m cs336_systems_tpu.analysis.trace_cli --step train_single
+
+Traces the named step family (analysis/tracekit.FAMILIES — the same tiny
+configs and factories graft-lint registers) on the current backend — the
+hermetic 8-virtual-device CPU mesh by default, a real TPU with
+``CS336_TPU_TRACE=1`` — and writes a StepProfile JSON: per-phase ×
+per-class device ms, top op rows, collective counts, achieved TF/s and
+MFU. ``--diff a.json b.json`` prints per-phase/per-class deltas with a
+noise threshold — the packaged form of CLAUDE.md's "compare traces, not
+walls" rule.
+
+Exit status: 0 ok, 1 failure (or, under --diff, any delta above
+threshold — so CI can gate on it).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (same escape
+# hatch as analysis/lint.py): profiling a real TPU goes through
+# CS336_TPU_TRACE=1, everything else must not grab the tunneled chip.
+if not os.environ.get("CS336_TPU_TRACE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import sys
+
+import jax
+
+if not os.environ.get("CS336_TPU_TRACE"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu.analysis import tracekit
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cs336_systems_tpu.analysis.trace_cli",
+        description="phase-attributed StepProfile tracing and diffing "
+                    "(see analysis/README.md)")
+    ap.add_argument("--step", metavar="FAMILY",
+                    help="step family to trace (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list traceable step families and exit")
+    ap.add_argument("--iters", type=int, default=3,
+                    help="traced executions to average over (default 3)")
+    ap.add_argument("--out", metavar="PATH",
+                    help="StepProfile JSON path "
+                         "(default <family>.stepprofile.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the StepProfile JSON to stdout instead of "
+                         "the human summary")
+    ap.add_argument("--top", type=int, default=15,
+                    help="op rows to keep in the profile (default 15)")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="diff two StepProfiles of the same family")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="diff flag threshold in %% (default 10)")
+    ap.add_argument("--abs-floor-ms", type=float, default=0.05,
+                    help="diff flag absolute floor in ms (default 0.05)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in tracekit.FAMILIES:
+            print(name)
+        return 0
+
+    if args.diff:
+        with open(args.diff[0]) as f:
+            a = json.load(f)
+        with open(args.diff[1]) as f:
+            b = json.load(f)
+        d = tracekit.diff_profiles(a, b, threshold_pct=args.threshold,
+                                   abs_floor_ms=args.abs_floor_ms)
+        print(json.dumps(d, indent=2) if args.json
+              else tracekit.format_diff(d))
+        return 1 if d["n_flagged"] else 0
+
+    if not args.step:
+        ap.error("one of --step, --list or --diff is required")
+    try:
+        profile = tracekit.profile_step(args.step, iters=args.iters,
+                                        top=args.top)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 1
+    out = args.out or f"{args.step}.stepprofile.json"
+    tracekit.write_profile(profile, out)
+    if args.json:
+        print(json.dumps(profile, indent=2))
+    else:
+        print(tracekit.format_profile(profile))
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

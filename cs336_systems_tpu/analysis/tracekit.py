@@ -1,0 +1,656 @@
+"""tracekit: phase-attributed device telemetry and MFU accounting.
+
+Every perf fact in BASELINE.md was earned by hand-reading Perfetto JSON
+through three copy-pasted ``scripts/trace_*.py``; CLAUDE.md's own rule —
+"decode walls swing ±7%; compare traces, not walls" — had no tool behind
+it. This module is that tool:
+
+- ``classify_op`` buckets every trace leaf op into a small taxonomy
+  (mxu-matmul / pallas-kernel / vpu-elementwise / copy-transpose /
+  collective-<kind> / dma / host) from its HLO opcode.
+- ``phase_of`` attributes each op to a model phase (fwd-attn, fwd-ffn,
+  bwd, optimizer, routing, kv-update, sampling) by reading the
+  ``jax.named_scope`` path XLA preserves in each instruction's
+  ``metadata={op_name=...}``. The scopes are threaded through
+  ``models/transformer.py`` (attn/ffn/...), ``models/decode.py``
+  (attn/kv_update/ffn/sampling), ``models/moe.py`` (routing) and
+  ``train.make_update_fn`` (optimizer); AD stamps ``transpose(jvp(...))``
+  on every backward op, which is how bwd is detected without any manual
+  bwd annotation. graft-lint's ``phase-scope`` rule keeps the
+  instrumentation from silently rotting.
+- ``profile_callable`` runs a jitted step under ``utils.profiling.trace``,
+  joins the trace's device-lane events against the OPTIMIZED HLO text of
+  the same compiled executable (event names ARE instruction names; ops
+  absent from the module — host lanes, profiler noise — simply don't
+  join), and emits a canonical ``StepProfile`` dict: per-phase × per-class
+  ms, top op rows, static collective counts, and achieved-TF/s + MFU from
+  the analytic FLOPs in ``analysis/flops.py``.
+- ``FAMILIES`` builds a concrete runnable bundle for each registered step
+  family (same factories as ``train_cli``/``parallel/serve`` — the lint
+  registry's taxonomy, minus the kernel-level ``gmm_fused_bwd`` which is
+  not a dispatchable step). ``trace_cli --step <family>`` drives them on
+  the 8-virtual-device CPU mesh or a real TPU.
+- ``diff_profiles`` is the packaged "compare traces, not walls": per-phase
+  and per-class deltas with a noise threshold.
+
+MULTI-DEVICE: a sharded executable logs each op once per device lane, so
+every total here is divided by ``iters × n_devices`` — per-step,
+PER-DEVICE milliseconds (the same convention as the fixed
+``utils.profiling.summarize_trace``). MFU is per-chip: global tokens are
+split across ``n_devices``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import os
+import re
+import tempfile
+from typing import Any, Callable
+
+from cs336_systems_tpu.analysis.flops import (
+    V5E_BF16_PEAK_FLOPS,
+    decode_flops_per_token,
+    model_flops_per_token,
+)
+
+SCHEMA = "stepprofile/v1"
+
+PHASES = ("fwd-attn", "fwd-ffn", "bwd", "optimizer", "routing",
+          "kv-update", "sampling", "other")
+
+# ---------------------------------------------------------------------------
+# HLO parsing: instruction name -> (opcode, named-scope path)
+
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"\b([a-z][a-z0-9\-]*)\(")
+_OP_NAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
+_CALL_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+
+
+@dataclasses.dataclass(frozen=True)
+class HloOp:
+    opcode: str
+    scope: str  # the op_name metadata (named-scope path), "" if absent
+    call_target: str = ""
+
+
+def parse_hlo_ops(hlo_text: str) -> dict[str, HloOp]:
+    """Map every instruction name in an (optimized) HLO module text to its
+    opcode + named-scope metadata. All computations are parsed, not just
+    ENTRY: ops inside while/conditional bodies execute under their own
+    names and show up as trace events; fusion-internal instructions never
+    do, so including them is harmless. Trace events are joined against
+    THIS map — an event whose name is not an instruction of the executed
+    module (host lanes, profiler bookkeeping) is ignored by construction.
+    """
+    ops: dict[str, HloOp] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        # the opcode is the first word( after the result type; tuple types
+        # "(f32[2], s32[])" contain no word directly attached to a "("
+        om = _OPCODE_RE.search(rest)
+        if not om:
+            continue
+        scope = _OP_NAME_RE.search(rest)
+        tgt = _CALL_TARGET_RE.search(rest)
+        ops[name] = HloOp(om.group(1), scope.group(1) if scope else "",
+                          tgt.group(1) if tgt else "")
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all",
+                "reduce-scatter", "collective-permute",
+                "collective-broadcast")
+_DMA_OPS = ("copy-start", "copy-done", "send", "send-done", "recv",
+            "recv-done", "async-start", "async-done", "async-update")
+_COPY_OPS = ("copy", "transpose", "reshape", "bitcast", "concatenate",
+             "slice", "dynamic-slice", "dynamic-update-slice", "gather",
+             "scatter", "pad", "reverse", "broadcast")
+_MXU_OPS = ("dot", "convolution", "triangular-solve", "cholesky")
+_HOST_OPS = ("infeed", "outfeed", "parameter", "constant", "tuple",
+             "get-tuple-element", "call", "after-all", "partition-id",
+             "replica-id")
+# containers: their duration is the SUM of their body ops' durations, and
+# the body ops trace as their own events — counting both double-counts
+_CONTAINER_OPS = ("while", "conditional")
+
+
+def classify_op(op: HloOp) -> str:
+    """One taxonomy bucket per HLO opcode. ``fusion`` (and the elementwise
+    long tail) lands in vpu-elementwise: XLA:TPU emits dots as ``fusion``
+    only with the dot as the fusion ROOT, which still traces as its own
+    ``dot``/``convolution`` event, so the matmul bucket doesn't leak."""
+    oc = op.opcode
+    for kind in _COLLECTIVES:
+        if oc == kind or oc == kind + "-start":
+            return f"collective-{kind}"
+        if oc == kind + "-done":
+            return "dma"  # the -done half is the wait, not the transfer
+    if oc == "custom-call":
+        if ("tpu_custom_call" in op.call_target
+                or "mosaic" in op.call_target.lower()
+                or "pallas" in op.scope.lower()):
+            return "pallas-kernel"
+        return "host"
+    if oc in _DMA_OPS:
+        return "dma"
+    if oc in _MXU_OPS:
+        return "mxu-matmul"
+    if oc in _COPY_OPS:
+        return "copy-transpose"
+    if oc in _HOST_OPS:
+        return "host"
+    return "vpu-elementwise"
+
+
+def phase_of(scope: str) -> str:
+    """Model phase from a named-scope path (HLO ``op_name`` metadata).
+
+    Precedence is inner-scope-first where scopes nest: ``transpose(`` (the
+    marker AD stamps on every backward op) beats everything — the whole
+    backward is one phase; ``kv_update`` nests inside decode's ``attn``;
+    ``routing`` nests inside the block's ``ffn``. The attention family
+    groups the projection/rope/sdpa sub-scopes transformer.py emits."""
+    if not scope:
+        return "other"
+    if "transpose(" in scope:
+        return "bwd"
+    if "sampling" in scope:
+        return "sampling"
+    if "kv_update" in scope:
+        return "kv-update"
+    if "routing" in scope:
+        return "routing"
+    if "optimizer" in scope:
+        return "optimizer"
+    if re.search(r"\b(attn|sdpa|qkv_proj|out_proj|rope)\b", scope):
+        return "fwd-attn"
+    if re.search(r"\b(ffn|lm_head)\b", scope):
+        return "fwd-ffn"
+    return "other"
+
+
+def count_collectives(op_map: dict[str, HloOp]) -> dict[str, int]:
+    """Static per-kind collective instruction counts in the compiled
+    module (``-start`` counts the op; ``-done`` is its completion)."""
+    out: dict[str, int] = {}
+    for op in op_map.values():
+        for kind in _COLLECTIVES:
+            if op.opcode == kind or op.opcode == kind + "-start":
+                out[kind] = out.get(kind, 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Trace reading
+
+
+def _newest_trace(logdir: str) -> str:
+    paths = []
+    for root, _, files in os.walk(logdir):
+        paths += [os.path.join(root, f) for f in files
+                  if f.endswith(".trace.json.gz")]
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {logdir}")
+    return max(paths, key=os.path.getmtime)
+
+
+def read_trace_events(logdir: str) -> list[dict]:
+    """Leaf duration events (ph == "X") from the newest trace under
+    ``logdir``. No lane filtering: the HLO join in ``attribute`` is the
+    filter (mirror lanes like "Framework Name Scope" repeat op durations
+    under scope names, which are not instruction names and don't join;
+    "TensorFlow Name Scope" lanes that DO use op names are excluded by
+    the same thread-name noise regex summarize_trace uses)."""
+    with gzip.open(_newest_trace(logdir)) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    threads = {
+        (e["pid"], e.get("tid")): e.get("args", {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    noise = re.compile(r"name scope|source|steps|python|tracer", re.I)
+    noisy = {k for k, n in threads.items() if noise.search(n or "")}
+    return [
+        e for e in events
+        if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) not in noisy
+    ]
+
+
+def attribute(events: list[dict], op_map: dict[str, HloOp],
+              divisor: float = 1.0):
+    """Join trace events against the HLO map and aggregate.
+
+    Returns ``(phase_class_us, op_rows)``: the first is
+    ``{phase: {class: us}}``, the second per-op rows (already summed over
+    repeats and divided). ``divisor`` is ``iters × n_devices`` — each op
+    logs once per device per execution."""
+    phase_class: dict[str, dict[str, float]] = {}
+    per_op: dict[str, list] = {}
+    for e in events:
+        name = e.get("name", "")
+        op = op_map.get(name)
+        if op is None:
+            continue
+        if op.opcode in _CONTAINER_OPS:
+            continue  # body ops trace under their own names
+        dur = e.get("dur", 0)
+        cls = classify_op(op)
+        if cls == "host":
+            continue  # parameters/tuples; no device compute
+        ph = phase_of(op.scope)
+        phase_class.setdefault(ph, {})
+        phase_class[ph][cls] = phase_class[ph].get(cls, 0) + dur
+        row = per_op.setdefault(name, [0.0, 0, ph, cls])
+        row[0] += dur
+        row[1] += 1
+    d = max(divisor, 1e-9)
+    rows = [
+        {
+            "op": name, "phase": ph, "class": cls,
+            "total_ms": round(us / d / 1e3, 4),
+            "count": round(n / d, 2),
+        }
+        for name, (us, n, ph, cls) in per_op.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return phase_class, rows
+
+
+# ---------------------------------------------------------------------------
+# Profiling a callable
+
+
+def profile_callable(
+    fn: Callable,
+    args: tuple,
+    *,
+    iters: int = 3,
+    tokens_per_step: float,
+    flops_per_token: float,
+    n_devices: int = 1,
+    family: str = "custom",
+    peak_flops: float = V5E_BF16_PEAK_FLOPS,
+    top: int = 15,
+) -> dict:
+    """Trace ``iters`` calls of ``fn(*args)`` and emit a StepProfile dict.
+
+    ``fn`` must be side-effect free w.r.t. its args (pass factories built
+    with ``donate=False``: a donated call invalidates ``args`` after the
+    first iteration). The function is lowered AND executed through the
+    same jit object so the parsed optimized-HLO module is byte-for-byte
+    the executed one — inner jits inline into it.
+
+    MFU convention: per-chip. ``tokens_per_step`` is the GLOBAL token
+    count; achieved TF/s divides by ``n_devices`` (the per-device-mean
+    device time already does).
+    """
+    import jax
+    import numpy as np
+
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    op_map = parse_hlo_ops(jfn.lower(*args).compile().as_text())
+
+    from cs336_systems_tpu.utils.profiling import trace
+
+    def fence(out):
+        # one element of EVERY leaf: dispatch is async on the tunneled
+        # runtime and block_until_ready has returned early (CLAUDE.md)
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+    fence(jfn(*args))  # compile + warm outside the trace window
+    with tempfile.TemporaryDirectory() as td:
+        with trace(td, host_tracer_level=0):
+            fence([jfn(*args) for _ in range(iters)])
+        events = read_trace_events(td)
+
+    divisor = iters * max(n_devices, 1)
+    phase_class_us, op_rows = attribute(events, op_map, divisor)
+
+    phase_ms = {
+        ph: round(sum(c.values()) / divisor / 1e3, 4)
+        for ph, c in phase_class_us.items()
+    }
+    class_ms: dict[str, float] = {}
+    for c in phase_class_us.values():
+        for cls, us in c.items():
+            class_ms[cls] = round(
+                class_ms.get(cls, 0.0) + us / divisor / 1e3, 4)
+    total_ms = round(sum(phase_ms.values()), 4)
+
+    achieved = (tokens_per_step / max(n_devices, 1) * flops_per_token
+                / (total_ms / 1e3) / 1e12) if total_ms else 0.0
+    return {
+        "schema": SCHEMA,
+        "family": family,
+        "backend": jax.default_backend(),
+        "n_devices": n_devices,
+        "iters": iters,
+        "total_device_ms_per_step": total_ms,
+        "phase_ms": phase_ms,
+        "class_ms": class_ms,
+        "phase_class_ms": {
+            ph: {cls: round(us / divisor / 1e3, 4) for cls, us in c.items()}
+            for ph, c in phase_class_us.items()
+        },
+        "collectives": count_collectives(op_map),
+        "ops": op_rows[:top],
+        "tokens_per_step": tokens_per_step,
+        "flops_per_token": flops_per_token,
+        # 4 significant figures, not fixed decimals: CPU-mesh tiny-config
+        # rates are ~1e-3 TF/s and would round to an unusable 0.0
+        "achieved_tflops": float(f"{achieved:.4g}"),
+        "mfu": float(f"{achieved * 1e12 / peak_flops:.4g}"),
+        "peak_flops": peak_flops,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Runnable bundles for every registered step family
+#
+# Same tiny configs and factories as the lint registry (analysis/registry),
+# but CONCRETE: real params on the mesh, donate=False so the traced calls
+# can repeat on the same buffers. gmm_fused_bwd is registry-only (a
+# kernel-level vjp trace, not a dispatchable step).
+
+
+@dataclasses.dataclass
+class Runner:
+    fn: Callable
+    args: tuple
+    tokens_per_step: float
+    flops_per_token: float
+    n_devices: int
+
+
+def _concrete_batch(cfg, b=8):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.randint(jax.random.PRNGKey(1), (b, cfg.context_length),
+                           0, cfg.vocab_size, jnp.int32)
+    return x, jnp.roll(x, -1, axis=-1)
+
+
+def _train_runner(cfg, step, b=8, n_devices=1) -> Runner:
+    import jax
+
+    from cs336_systems_tpu.train import init_train_state
+
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    x, y = _concrete_batch(cfg, b)
+    return Runner(step, (params, opt, x, y), b * cfg.context_length,
+                  model_flops_per_token(cfg), n_devices)
+
+
+def _placed_train_runner(cfg, step, mesh, pspecs, b=8) -> Runner:
+    import jax
+
+    from cs336_systems_tpu.optim.adamw import adamw_init
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import adamw_state_specs, shard_tree
+
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    opt = adamw_init(params)
+    params = shard_tree(params, mesh, pspecs)
+    opt = shard_tree(opt, mesh, adamw_state_specs(pspecs))
+    x, y = _concrete_batch(cfg, b)
+    return Runner(step, (params, opt, x, y), b * cfg.context_length,
+                  model_flops_per_token(cfg), mesh.size)
+
+
+def _hp():
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+
+    return AdamWHparams()
+
+
+def _build_train_single() -> Runner:
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = _tiny_cfg()
+    return _train_runner(cfg, make_train_step(cfg, _hp(), donate=False))
+
+
+def _build_train_single_bf16() -> Runner:
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = _tiny_cfg(vocab_size=512, context_length=256, d_model=256,
+                    num_heads=4, d_ff=512, compute_dtype="bfloat16")
+    return _train_runner(cfg, make_train_step(cfg, _hp(), donate=False), b=4)
+
+
+def _build_train_moe(dispatch: str) -> Runner:
+    from cs336_systems_tpu.analysis.registry import _moe_cfg
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = _moe_cfg(moe_dispatch=dispatch)
+    return _train_runner(cfg, make_train_step(cfg, _hp(), donate=False))
+
+
+def _build_train_dp(variant: str) -> Runner:
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    step = make_dp_train_step(cfg, _hp(), mesh, variant=variant,
+                              donate=False)
+    return _train_runner(cfg, step, n_devices=mesh.size)
+
+
+def _build_train_tp() -> Runner:
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel import tp
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    step = tp.make_tp_train_step(cfg, _hp(), mesh, donate=False)
+    return _placed_train_runner(cfg, step, mesh, tp.param_specs(cfg))
+
+
+def _build_train_tp_sp() -> Runner:
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel import tp, tp_sp
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    step = tp_sp.make_tp_sp_train_step(cfg, _hp(), mesh, donate=False)
+    return _placed_train_runner(cfg, step, mesh, tp.param_specs(cfg))
+
+
+def _build_train_ep_a2a() -> Runner:
+    from cs336_systems_tpu.analysis.registry import _moe_cfg
+    from cs336_systems_tpu.parallel import ep
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    cfg = _moe_cfg()
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    step = ep.make_ep_train_step(cfg, _hp(), mesh, donate=False)
+    return _placed_train_runner(cfg, step, mesh, ep.param_specs(cfg))
+
+
+def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
+                 ragged=False) -> Runner:
+    import jax
+    import numpy as np
+
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import make_sharded_generate
+
+    cfg = _tiny_cfg() if ep_axis is None else _tiny_cfg(num_experts=8,
+                                                        moe_top_k=2)
+    mesh = make_mesh(mesh_axes)
+    max_new = 4
+    gen = make_sharded_generate(
+        cfg, mesh, max_new_tokens=max_new, dp_axis=dp_axis,
+        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    b, p = 8, 6
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, p), 0,
+                             cfg.vocab_size)
+    key = jax.random.PRNGKey(2)
+    if ragged:
+        lens = np.full((b,), p, np.int32)
+        lens[: b // 2] = 3
+        fn = lambda pr, i, k: gen(pr, i, k, prompt_lens=lens)
+    else:
+        fn = gen
+    return Runner(fn, (params, ids, key), b * max_new,
+                  decode_flops_per_token(cfg), mesh.size)
+
+
+FAMILIES: dict[str, Callable[[], Runner]] = {
+    "train_single": _build_train_single,
+    "train_single_bf16": _build_train_single_bf16,
+    "train_moe_sorted": lambda: _build_train_moe("sorted"),
+    "train_moe_gmm": lambda: _build_train_moe("gmm"),
+    "train_dp_naive": lambda: _build_train_dp("naive"),
+    "train_dp_bucketed": lambda: _build_train_dp("bucketed"),
+    "train_tp": _build_train_tp,
+    "train_tp_sp": _build_train_tp_sp,
+    "train_ep_a2a": _build_train_ep_a2a,
+    "serve_dp": lambda: _build_serve({"dp": 8}, "dp"),
+    "serve_tp": lambda: _build_serve({"tp": 4}, None, "tp"),
+    "serve_ep": lambda: _build_serve({"dp": 2, "ep": 4}, "dp", None, "ep"),
+    "serve_tp_ragged": lambda: _build_serve({"dp": 2, "tp": 4}, "dp", "tp",
+                                            None, True),
+}
+
+
+def profile_step(family: str, iters: int = 3, top: int = 15) -> dict:
+    """Build the family's runnable bundle and profile it. The StepProfile's
+    phase breakdown, collective counts and MFU estimate come from the same
+    compiled module the runs execute."""
+    if family not in FAMILIES:
+        raise KeyError(
+            f"unknown step family {family!r}; known: {sorted(FAMILIES)}")
+    r = FAMILIES[family]()
+    return profile_callable(
+        r.fn, r.args, iters=iters, tokens_per_step=r.tokens_per_step,
+        flops_per_token=r.flops_per_token, n_devices=r.n_devices,
+        family=family, top=top,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Diffing: the packaged "compare traces, not walls"
+
+
+def diff_profiles(a: dict, b: dict, threshold_pct: float = 10.0,
+                  abs_floor_ms: float = 0.05) -> dict:
+    """Per-phase and per-class deltas between two StepProfiles.
+
+    A row is FLAGGED only when BOTH gates trip: |Δ| > ``abs_floor_ms``
+    (device-lane timings jitter by tens of µs run to run — a 40 µs swing
+    on a 50 µs phase is noise, not a 80% regression) and |Δ%| >
+    ``threshold_pct`` of the baseline. Identical runs flag nothing.
+    """
+    if a.get("family") != b.get("family"):
+        raise ValueError(
+            f"profiles are different families: {a.get('family')!r} vs "
+            f"{b.get('family')!r} — deltas would be meaningless")
+    rows = []
+    for kind, field in (("phase", "phase_ms"), ("class", "class_ms")):
+        av, bv = a.get(field, {}), b.get(field, {})
+        for key in sorted(set(av) | set(bv)):
+            x, y = av.get(key, 0.0), bv.get(key, 0.0)
+            delta = y - x
+            pct = (delta / x * 100.0) if x else (float("inf") if y else 0.0)
+            rows.append({
+                "kind": kind, "key": key,
+                "a_ms": x, "b_ms": y,
+                "delta_ms": round(delta, 4),
+                "delta_pct": round(pct, 1) if pct != float("inf") else None,
+                "flagged": abs(delta) > abs_floor_ms
+                and (x == 0 or abs(pct) > threshold_pct),
+            })
+    ta = a.get("total_device_ms_per_step", 0.0)
+    tb = b.get("total_device_ms_per_step", 0.0)
+    return {
+        "family": a.get("family"),
+        "total_a_ms": ta,
+        "total_b_ms": tb,
+        "total_delta_ms": round(tb - ta, 4),
+        "threshold_pct": threshold_pct,
+        "abs_floor_ms": abs_floor_ms,
+        "rows": rows,
+        "n_flagged": sum(r["flagged"] for r in rows),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+
+
+def format_profile(p: dict) -> str:
+    lines = [
+        f"StepProfile {p['family']}  backend={p['backend']} "
+        f"devices={p['n_devices']} iters={p['iters']}",
+        f"  device time/step (per device): "
+        f"{p['total_device_ms_per_step']:.3f} ms   "
+        f"achieved {p['achieved_tflops']:.3g} TF/s/chip   "
+        f"MFU {p['mfu'] * 100:.3g}%",
+    ]
+    if p.get("collectives"):
+        cs = ", ".join(f"{k}×{v}" for k, v in sorted(p["collectives"].items()))
+        lines.append(f"  collectives: {cs}")
+    lines.append("  phase × class (ms/step):")
+    classes = sorted(p.get("class_ms", {}),
+                     key=lambda c: -p["class_ms"][c])
+    for ph in sorted(p.get("phase_ms", {}), key=lambda x: -p["phase_ms"][x]):
+        cells = p["phase_class_ms"].get(ph, {})
+        detail = "  ".join(
+            f"{c}={cells[c]:.3f}" for c in classes if c in cells)
+        lines.append(f"    {ph:<10} {p['phase_ms'][ph]:8.3f}   {detail}")
+    lines.append("  top ops:")
+    for r in p.get("ops", [])[:10]:
+        lines.append(
+            f"    {r['total_ms']:8.3f} ms  ×{r['count']:<7g} "
+            f"{r['phase']:<9} {r['class']:<16} {r['op']}")
+    return "\n".join(lines)
+
+
+def format_diff(d: dict) -> str:
+    lines = [
+        f"diff [{d['family']}]  total {d['total_a_ms']:.3f} -> "
+        f"{d['total_b_ms']:.3f} ms/step ({d['total_delta_ms']:+.3f})   "
+        f"threshold ±{d['threshold_pct']}% & >{d['abs_floor_ms']} ms",
+    ]
+    for r in d["rows"]:
+        flag = " <-- FLAGGED" if r["flagged"] else ""
+        pct = f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None else "new"
+        lines.append(
+            f"  {r['kind']:<5} {r['key']:<28} {r['a_ms']:9.3f} -> "
+            f"{r['b_ms']:9.3f}  {r['delta_ms']:+9.3f} ms  {pct:>8}{flag}")
+    lines.append(f"{d['n_flagged']} row(s) above threshold")
+    return "\n".join(lines)
+
+
+def write_profile(p: dict, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(p, f, indent=2)
+        f.write("\n")

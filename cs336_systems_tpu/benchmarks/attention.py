@@ -24,6 +24,7 @@ from cs336_systems_tpu.ops.attention import attention_with_lse, causal_mask
 from cs336_systems_tpu.ops.flash_attention import flash_attention
 from cs336_systems_tpu.utils.profiling import peak_bytes
 from cs336_systems_tpu.utils.timing import (
+    emit_row,
     error_cell,
     print_table,
     results_table,
@@ -143,31 +144,32 @@ def run_attention_benchmark(
     latex_path: str | None = None,
     oom_ok: bool = True,
     timing: str = "wall",
+    out_path: str | None = None,
 ):
     """Grid sweep; with ``oom_ok`` a failing cell is recorded as a null row
     (parity with the reference's OOM-catch, benchmark_attention.py:95-109)
-    instead of aborting the sweep; ``oom_ok=False`` re-raises for debugging."""
+    instead of aborting the sweep; ``oom_ok=False`` re-raises for debugging.
+    Every completed cell is flushed immediately (and appended to ``out_path``
+    as JSONL when set) so a stuck sweep loses nothing finished."""
     rows = []
     for impl in impls:
         for d in head_dims:
             for s in seq_lens:
                 for dt in dtypes:
                     try:
-                        rows.append(
-                            benchmark_attention_cell(
-                                impl, s, d, batch=batch, dtype=dt,
-                                causal=causal, warmup=warmup, iters=iters,
-                                timing=timing,
-                            )
+                        row = benchmark_attention_cell(
+                            impl, s, d, batch=batch, dtype=dt,
+                            causal=causal, warmup=warmup, iters=iters,
+                            timing=timing,
                         )
                     except Exception as e:
                         if not oom_ok:
                             raise
-                        rows.append(
-                            {"impl": impl, "seq": s, "d": d, "batch": batch,
-                             "dtype": dt, "causal": causal,
-                             "error": error_cell(e)}
-                        )
+                        row = {"impl": impl, "seq": s, "d": d, "batch": batch,
+                               "dtype": dt, "causal": causal,
+                               "error": error_cell(e)}
+                    rows.append(row)
+                    emit_row(row, out_path)
     return results_table(rows, latex_path)
 
 
@@ -263,6 +265,8 @@ def main(argv=None) -> None:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--latex", default=None)
+    p.add_argument("--out", default=None,
+                   help="append each completed cell as a JSON line here")
     p.add_argument("--plots", default=None, help="prefix for output figures")
     p.add_argument("--timing", choices=["wall", "device"], default=None,
                    help="device = profiler-trace device-lane time per call "
@@ -274,7 +278,7 @@ def main(argv=None) -> None:
         impls=args.impls, seq_lens=args.seqs, head_dims=args.dims,
         batch=args.batch, dtypes=args.dtypes, causal=not args.no_causal,
         warmup=args.warmup, iters=args.iters, latex_path=args.latex,
-        timing=timing,
+        timing=timing, out_path=args.out,
     )
     print_table(df)
     if args.plots:

@@ -30,7 +30,12 @@ import jax
 import jax.numpy as jnp
 
 from cs336_systems_tpu.models.transformer import config_for_size, init_transformer_lm
-from cs336_systems_tpu.utils.timing import print_table, results_table, timed
+from cs336_systems_tpu.utils.timing import (
+    emit_row,
+    print_table,
+    results_table,
+    timed,
+)
 
 
 def _time_best(fn, reps: int = 3):
@@ -91,6 +96,7 @@ def benchmark_decode(
     experts: int = 0,
     moe_top_k: int = 2,
     ragged: bool = False,
+    out_path: str | None = None,
 ) -> list[dict]:
     from cs336_systems_tpu.models.decode import (
         generate_kv,
@@ -119,6 +125,12 @@ def benchmark_decode(
     moe_tag = f"_moe{experts}k{moe_top_k}" if experts else ""
     rows = []
 
+    def _add(row):
+        # flush each finished row immediately: single rows here take
+        # minutes on the remote runtime and a hung sweep loses nothing
+        rows.append(row)
+        emit_row(row, out_path)
+
     # KV-cache path: whole generation in one jit
     dt, toks = _time_best(
         lambda: generate_kv(
@@ -126,7 +138,7 @@ def benchmark_decode(
         ),
         reps,
     )
-    rows.append(
+    _add(
         {
             "path": f"kv_cache{moe_tag}",
             "prompt": prompt_len,
@@ -146,7 +158,7 @@ def benchmark_decode(
     dt_p, _ = _time_best(
         lambda: prefill_jit(params, jnp.asarray([prompt])), reps
     )
-    rows.append(
+    _add(
         {
             "path": f"prefill_only{moe_tag}",
             "prompt": prompt_len,
@@ -191,7 +203,7 @@ def benchmark_decode(
                 ),
                 reps,
             )
-            rows.append(batched_row(f"kv_cache_b{b}{tag}{moe_tag}", dt_b))
+            _add(batched_row(f"kv_cache_b{b}{tag}{moe_tag}", dt_b))
         if ragged and b >= 2:  # b=1 has no spread — the row would be
             # uniform full-length mislabeled as ragged
             # RAGGED row: per-row prompt lengths spread 4x (P/4 .. P,
@@ -218,7 +230,7 @@ def benchmark_decode(
                 ),
                 reps,
             )
-            rows.append(batched_row(f"kv_cache_b{b}_ragged4x{moe_tag}", dt_r))
+            _add(batched_row(f"kv_cache_b{b}_ragged4x{moe_tag}", dt_r))
 
     if uncached:
         # reference semantics: full forward per token (model.py:283-308)
@@ -228,7 +240,7 @@ def benchmark_decode(
             ),
             max(1, reps - 2),
         )
-        rows.append(
+        _add(
             {
                 "path": f"uncached_loop{moe_tag}",
                 "prompt": prompt_len,
@@ -254,6 +266,8 @@ def main(argv=None) -> None:
     p.add_argument("--no-uncached", dest="uncached", action="store_false",
                    help="skip the slow full-forward-per-token baseline")
     p.add_argument("--latex", default=None)
+    p.add_argument("--out", default=None,
+                   help="append each completed row as a JSON line here")
     p.add_argument("--experts", type=int, default=0,
                    help="serve a Mixture-of-Experts backbone (E experts, "
                         "top-k routed per token — models/moe.py)")
@@ -270,7 +284,7 @@ def main(argv=None) -> None:
             batch_sizes=tuple(args.batches),
             uncached=args.uncached and j == 0,  # the slow baseline once
             reps=args.reps, experts=args.experts, moe_top_k=args.moe_top_k,
-            ragged=args.ragged,
+            ragged=args.ragged, out_path=args.out,
         )
     df = results_table(rows, args.latex)
     print_table(df)

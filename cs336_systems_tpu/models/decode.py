@@ -36,6 +36,7 @@ from cs336_systems_tpu.models.transformer import (
     top_p_filter,
     transformer_lm,
 )
+from cs336_systems_tpu.utils.profiling import annotate
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None,
@@ -176,35 +177,44 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
     h = _local_heads(bp["attn"], cfg)
     hsplit = lambda t: t.reshape(b, 1, h, dh).transpose(0, 2, 1, 3)
 
-    hx = rmsnorm(bp["ln1"], x)
-    q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
-    k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
-    v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
-    # [1] broadcasts over rows; [B,1,1] gives each row its own angle row
-    positions = pos[:, None, None] if pos.ndim == 1 else pos[None]
-    q = apply_rope(q, cos, sin, positions)
-    k = apply_rope(k, cos, sin, positions)
+    with annotate("attn"):
+        hx = rmsnorm(bp["ln1"], x)
+        q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
+        k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
+        v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
+        # [1] broadcasts over rows; [B,1,1] gives each row its own angle row
+        positions = pos[:, None, None] if pos.ndim == 1 else pos[None]
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
 
-    attend = attend_len if attend_len is not None else kv.shape[-2]
-    impl = _resolve_impl(attn_impl, attend, dh, kv.dtype.itemsize)
-    if impl == "pallas":
-        from cs336_systems_tpu.ops.decode_attention import (
-            decode_attention_update,
-        )
+        attend = attend_len if attend_len is not None else kv.shape[-2]
+        impl = _resolve_impl(attn_impl, attend, dh, kv.dtype.itemsize)
+        # "kv_update" nests inside "attn": tracekit's phase precedence
+        # checks the inner scope first, so the fused update+attend kernel
+        # (and the XLA DUS+softmax fallback) land in kv_update, the
+        # projections/rope around it in attn.
+        if impl == "pallas":
+            from cs336_systems_tpu.ops.decode_attention import (
+                decode_attention_update,
+            )
 
-        attn, kv = decode_attention_update(
-            q, k, v, kv, pos, window=cfg.attn_window, attend_len=attend_len,
-        )
-    else:
-        attn, kv = _attend_update_xla(
-            q, kv, k, v, pos, cfg.attn_window, attend_len
-        )
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
-    attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-    if reduce_axis is not None:
-        attn_out = jax.lax.psum(attn_out, reduce_axis)
+            with annotate("kv_update"):
+                attn, kv = decode_attention_update(
+                    q, k, v, kv, pos, window=cfg.attn_window,
+                    attend_len=attend_len,
+                )
+        else:
+            with annotate("kv_update"):
+                attn, kv = _attend_update_xla(
+                    q, kv, k, v, pos, cfg.attn_window, attend_len
+                )
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, h * dh)
+        attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+        if reduce_axis is not None:
+            attn_out = jax.lax.psum(attn_out, reduce_axis)
     x = x + attn_out
-    ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+    with annotate("ffn"):
+        ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
     # The tp reduce applies to the DENSE SwiGLU's row-parallel w2
     # partial sums only: under MoE serving the expert weights are never
     # tp-sharded (replicated, or ep-sharded with _ffn psumming over ep
@@ -354,20 +364,22 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
 
     def body(carry, bp):
         x = carry
-        hsplit = lambda t: t.reshape(b, plen, h, dh).transpose(0, 2, 1, 3)
-        hx = rmsnorm(bp["ln1"], x)
-        q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
-        k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
-        v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
-        attn = attention_with_lse(q, k, v, mask)[0]
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * dh)
-        attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
-        if reduce_axis is not None:
-            attn_out = jax.lax.psum(attn_out, reduce_axis)
+        with annotate("attn"):
+            hsplit = lambda t: t.reshape(b, plen, h, dh).transpose(0, 2, 1, 3)
+            hx = rmsnorm(bp["ln1"], x)
+            q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
+            k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
+            v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+            attn = attention_with_lse(q, k, v, mask)[0]
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, plen, h * dh)
+            attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+            if reduce_axis is not None:
+                attn_out = jax.lax.psum(attn_out, reduce_axis)
         x = x + attn_out
-        ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+        with annotate("ffn"):
+            ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
         # same tp/ep reduce split as _decode_block: MoE ffn output is
         # never tp-sharded (ep-psum'd internally or replicated)
         if reduce_axis is not None and cfg.num_experts == 0:
@@ -394,12 +406,15 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     # prefix (one-time cost at prefill; per-layer leaves — init_kv_cache)
     from cs336_systems_tpu.ops.decode_attention import pack_kv
 
-    cache = {
-        "kv": tuple(
-            jax.lax.dynamic_update_slice(c, pack_kv(ks[l], vs[l]), (0, 0, 0, 0))
-            for l, c in enumerate(cache["kv"])
-        ),
-    }
+    with annotate("kv_update"):
+        cache = {
+            "kv": tuple(
+                jax.lax.dynamic_update_slice(
+                    c, pack_kv(ks[l], vs[l]), (0, 0, 0, 0)
+                )
+                for l, c in enumerate(cache["kv"])
+            ),
+        }
     return logits, cache, nxt
 
 
@@ -456,23 +471,24 @@ def _sample(logits, key, temperature: float, top_k: int | None,
     the single-device draws; row-keyed streams depend only on each row's
     global index — what makes sharded serving (parallel/serve.py)
     bit-identical to the single-device path."""
-    logits = logits / temperature
-    if top_k is not None:
-        k = min(top_k, logits.shape[-1])
-        if approx_top_k:
-            kth = jax.lax.approx_max_k(logits, k)[0][..., -1:]
-        else:
-            kth = jax.lax.top_k(logits, k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None:
-        logits = top_p_filter(logits, top_p)
-    if row_key_offset is not None:
-        rows = jnp.arange(logits.shape[0], dtype=jnp.int32) + row_key_offset
-        keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
-        return jax.vmap(
-            lambda k_, l: jax.random.categorical(k_, l, axis=-1)
-        )(keys, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    with annotate("sampling"):
+        logits = logits / temperature
+        if top_k is not None:
+            k = min(top_k, logits.shape[-1])
+            if approx_top_k:
+                kth = jax.lax.approx_max_k(logits, k)[0][..., -1:]
+            else:
+                kth = jax.lax.top_k(logits, k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            logits = top_p_filter(logits, top_p)
+        if row_key_offset is not None:
+            rows = jnp.arange(logits.shape[0], dtype=jnp.int32) + row_key_offset
+            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+            return jax.vmap(
+                lambda k_, l: jax.random.categorical(k_, l, axis=-1)
+            )(keys, logits)
+        return jax.random.categorical(key, logits, axis=-1)
 
 
 def _check_prompt_lens(prompt_lens, ids_shape) -> jax.Array:

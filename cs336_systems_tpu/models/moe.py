@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from cs336_systems_tpu.models.layers import init_linear, init_swiglu, linear, swiglu
 from cs336_systems_tpu.ops.grouped_matmul import float0_like as _float0_like
+from cs336_systems_tpu.utils.profiling import annotate
 
 
 def _prefix_count(onehot: jax.Array) -> jax.Array:
@@ -356,11 +357,14 @@ def _moe_ffn_sorted(params, xt, top_k, capacity, compute_dtype,
     e = params["router"]["weight"].shape[0]
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
 
-    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
-    gates = jax.nn.softmax(router_logits, axis=-1)
-    expert, pos, weight, aux = route_topk_indexed(
-        gates, top_k, capacity, dp_axis
-    )
+    with annotate("routing"):
+        router_logits = linear(
+            params["router"], xt.astype(jnp.float32), jnp.float32
+        )
+        gates = jax.nn.softmax(router_logits, axis=-1)
+        expert, pos, weight, aux = route_topk_indexed(
+            gates, top_k, capacity, dp_axis
+        )
 
     # Local buffer: a shard can land at most min(capacity, T·k) of its own
     # claims; under dp the GLOBAL pos can exceed the local buffer, so
@@ -460,11 +464,14 @@ def _moe_ffn_ep_a2a(params, xt, top_k, capacity, compute_dtype,
     w = e // e_local
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
 
-    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
-    gates = jax.nn.softmax(router_logits, axis=-1)
-    expert, pos, weight, aux = route_topk_indexed(
-        gates, top_k, capacity, token_axes
-    )
+    with annotate("routing"):
+        router_logits = linear(
+            params["router"], xt.astype(jnp.float32), jnp.float32
+        )
+        gates = jax.nn.softmax(router_logits, axis=-1)
+        expert, pos, weight, aux = route_topk_indexed(
+            gates, top_k, capacity, token_axes
+        )
     keep = pos < capacity  # [T, k], global-fill-order consistent
 
     s = t * top_k  # per-destination send bound (static worst case)
@@ -563,10 +570,13 @@ def moe_ffn_ep_local(params, x, top_k: int, compute_dtype=None,
         raise ValueError(f"global experts {e} not a multiple of local {e_local}")
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
 
-    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
-    gates = jax.nn.softmax(router_logits, axis=-1)
-    vals, idx = jax.lax.top_k(gates, top_k)  # [T, k]
-    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    with annotate("routing"):
+        router_logits = linear(
+            params["router"], xt.astype(jnp.float32), jnp.float32
+        )
+        gates = jax.nn.softmax(router_logits, axis=-1)
+        vals, idx = jax.lax.top_k(gates, top_k)  # [T, k]
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
 
     local_lo = jax.lax.axis_index(ep_axis) * e_local
     is_local = (idx >= local_lo) & (idx < local_lo + e_local)  # [T, k]
@@ -617,14 +627,17 @@ def _moe_ffn_gmm(params, xt, top_k, compute_dtype, dp_axis: str | None,
     e = params["router"]["weight"].shape[0]
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
 
-    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
-    gates = jax.nn.softmax(router_logits, axis=-1)
-    # Route LOCALLY even under dp (dropless compute needs no cross-shard
-    # fill positions — route_topk_indexed's [W, E] all-gathers would buy
-    # nothing); only the aux loss takes the global-mean form below.
-    expert, pos, weight, aux = route_topk_indexed(
-        gates, top_k, t * top_k, None
-    )
+    with annotate("routing"):
+        router_logits = linear(
+            params["router"], xt.astype(jnp.float32), jnp.float32
+        )
+        gates = jax.nn.softmax(router_logits, axis=-1)
+        # Route LOCALLY even under dp (dropless compute needs no cross-shard
+        # fill positions — route_topk_indexed's [W, E] all-gathers would buy
+        # nothing); only the aux loss takes the global-mean form below.
+        expert, pos, weight, aux = route_topk_indexed(
+            gates, top_k, t * top_k, None
+        )
     if dp_axis is not None:
         top1 = jax.nn.one_hot(expert[:, 0], e, dtype=jnp.float32)
         m_g = jax.lax.pmean(jnp.mean(gates, axis=0), dp_axis)
@@ -767,9 +780,12 @@ def moe_ffn(params, x: jax.Array, top_k: int, capacity_factor: float,
         raise ValueError(f"unknown moe dispatch {dispatch!r}")
     c = capacity or moe_capacity(t, e, top_k, capacity_factor)
 
-    router_logits = linear(params["router"], xt.astype(jnp.float32), jnp.float32)
-    gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E] fp32
-    dispatch_t, combine, aux = route_topk(gates, top_k, c)
+    with annotate("routing"):
+        router_logits = linear(
+            params["router"], xt.astype(jnp.float32), jnp.float32
+        )
+        gates = jax.nn.softmax(router_logits, axis=-1)  # [T, E] fp32
+        dispatch_t, combine, aux = route_topk(gates, top_k, c)
 
     in_dtype = xt.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
     xe = jnp.einsum(

@@ -19,8 +19,9 @@ from cs336_systems_tpu.models.transformer import (
     TransformerConfig,
     transformer_lm_with_aux,
 )
-from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy, global_grad_norm
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init, adamw_update
+from cs336_systems_tpu.utils.profiling import annotate
 
 
 def lm_loss(params, x, y, cfg: TransformerConfig, mesh=None):
@@ -78,6 +79,7 @@ def make_update_fn(
     *,
     value_and_grad: Callable | None = None,
     accum_steps: int = 1,
+    metrics: bool = False,
 ) -> Callable:
     """The one canonical step body: ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
@@ -100,6 +102,18 @@ def make_update_fn(
     ``accum_steps > 1``: gradient accumulation — x/y gain a leading
     ``[accum_steps, ...]`` microbatch dim and the update applies the
     microbatch-averaged gradient (see ``make_accum_value_and_grad``).
+
+    ``metrics``: the update additionally returns a fourth element
+    ``{"grad_norm": pre-clip global L2 norm}`` — the train_cli
+    ``--telemetry`` heartbeat. Off by default so the three-tuple contract
+    every parallelism wrapper unpacks stays unchanged.
+
+    Phase annotation: the clip + schedule + AdamW tail runs under an
+    ``annotate("optimizer")`` scope. Together with the model's own scopes
+    (transformer.py: attn/ffn/…) and the ``transpose(...)`` markers AD
+    stamps on backward ops, this is what lets ``analysis/tracekit``
+    attribute device time to phases — graft-lint's ``phase-scope`` rule
+    keeps the annotation from rotting.
     """
     if value_and_grad is not None and accum_steps > 1:
         raise ValueError(
@@ -114,10 +128,15 @@ def make_update_fn(
 
     def update(params, opt_state, x, y):
         loss, grads = value_and_grad(params, x, y)
-        if clip_norm is not None:
-            grads = clip_gradients(grads, clip_norm)
-        lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-        params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        with annotate("optimizer"):
+            gnorm = global_grad_norm(grads) if (metrics or clip_norm is not None) \
+                else None
+            if clip_norm is not None:
+                grads = clip_gradients(grads, clip_norm, norm=gnorm)
+            lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
+            params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+        if metrics:
+            return params, opt_state, loss, {"grad_norm": gnorm}
         return params, opt_state, loss
 
     return update
@@ -130,6 +149,7 @@ def make_train_step(
     lr_schedule: Callable | None = None,
     donate: bool = True,
     accum_steps: int = 1,
+    metrics: bool = False,
 ) -> Callable:
     """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -137,11 +157,13 @@ def make_train_step(
     consumed by the update anyway), halving the step's HBM high-water mark.
     ``accum_steps > 1`` expects x/y shaped ``[accum_steps, micro_batch, S]``
     and applies one optimizer step on the microbatch-averaged gradient.
+    ``metrics`` appends ``{"grad_norm": ...}`` as a fourth output (see
+    ``make_update_fn``) — the train_cli ``--telemetry`` path.
     """
 
     update = make_update_fn(
         functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule,
-        accum_steps=accum_steps,
+        accum_steps=accum_steps, metrics=metrics,
     )
     return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 

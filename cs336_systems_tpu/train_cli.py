@@ -436,6 +436,13 @@ def main(argv=None) -> None:
                    help="optimizer steps per dispatch (in-jit loop; "
                         "single-device mode; default 10 on TPU, 1 elsewhere)")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--telemetry", default=None, metavar="OUT.jsonl",
+                   help="append one JSON line per optimizer step (step, "
+                        "loss, grad_norm, tokens_per_s, live_buffer_bytes, "
+                        "wall_s). Forces one dispatch per step and fences "
+                        "every step's loss to host — a measurement mode, "
+                        "not a throughput mode (grad_norm is null in "
+                        "sharded modes: their steps don't expose it)")
     p.add_argument("--eval-every", type=int, default=0,
                    help="evaluate held-out loss every N steps (reserves the "
                         "final 10%% of the corpus as the eval split)")
@@ -532,6 +539,8 @@ def main(argv=None) -> None:
     loop_chunk = args.loop_steps or (10 if on_tpu else 1)
     if args.parallel != "none":
         loop_chunk = 1  # in-jit loop is wired for the single-device path
+    if args.telemetry:
+        loop_chunk = 1  # per-step lines need one dispatch per step
 
     # Donation is safe with checkpointing: save_checkpoint pulls the state
     # to host before the next run() call consumes the donated buffers.
@@ -540,6 +549,20 @@ def main(argv=None) -> None:
         mesh_axes=mesh_axes, microbatches=args.microbatches,
     )
     run, to_params, mesh = layer.run, layer.to_params, layer.mesh
+    run_metrics = None
+    if args.telemetry and args.parallel == "none":
+        from cs336_systems_tpu.train import make_train_step
+
+        # a metrics build of the same canonical step: identical update
+        # math, plus the pre-clip grad norm as a device output
+        _mstep = make_train_step(
+            cfg, hp, lr_schedule=schedule, donate=True, metrics=True
+        )
+
+        def run_metrics(state, x, y):
+            params, opt, loss, m = _mstep(*state, x, y)
+            return (params, opt), loss, m["grad_norm"]
+
     run_one = None
     if loop_chunk > 1:
         from cs336_systems_tpu.train import make_train_step
@@ -621,10 +644,19 @@ def main(argv=None) -> None:
         )
         print(f"checkpointed step {step_no} -> {args.checkpoint_dir}")
 
+    tele = None
+    if args.telemetry:
+        import json
+
+        from cs336_systems_tpu.utils.profiling import live_buffer_bytes
+
+        tele = open(args.telemetry, "a")
+
     t0 = time.perf_counter()
     tokens_done = 0
     step_i = step_saved = start_step
     while step_i < args.steps:
+        gnorm = None
         chunk = min(loop_chunk, args.steps - step_i)
         if chunk == loop_chunk and loop_chunk > 1:
             # step-keyed stream: the chunk's key depends only on
@@ -638,12 +670,30 @@ def main(argv=None) -> None:
                 corpus, args.batch, args.ctx, rng=chunk_rng(step_i),
                 sharding=sharding,
             )
-            step_fn = run_one if (loop_chunk > 1 and run_one) else run
-            state, loss = step_fn(state, x, y)
+            if run_metrics is not None:
+                state, loss, gnorm = run_metrics(state, x, y)
+            else:
+                step_fn = run_one if (loop_chunk > 1 and run_one) else run
+                state, loss = step_fn(state, x, y)
             chunk = 1
         prev = step_i
         step_i += chunk
         tokens_done += args.batch * args.ctx * chunk
+        if tele is not None:
+            # float(loss) is the hard device fence: wall below reflects
+            # COMPLETED work, not the async dispatch queue (CLAUDE.md)
+            loss_val = float(loss)
+            wall = time.perf_counter() - t0
+            tele.write(json.dumps({
+                "step": step_i,
+                "loss": round(loss_val, 6),
+                "grad_norm": (round(float(gnorm), 6)
+                              if gnorm is not None else None),
+                "tokens_per_s": round(tokens_done / wall, 1),
+                "live_buffer_bytes": live_buffer_bytes(),
+                "wall_s": round(wall, 3),
+            }) + "\n")
+            tele.flush()
         if args.log_every and (
             step_i % args.log_every == 0
             or step_i >= args.steps
@@ -669,6 +719,9 @@ def main(argv=None) -> None:
             step_saved = step_i
     if args.checkpoint_dir and step_saved != step_i:
         save(step_i)
+    if tele is not None:
+        tele.close()
+        print(f"telemetry -> {args.telemetry}")
 
 
 if __name__ == "__main__":

@@ -153,18 +153,50 @@ def _iter_trace_files(logdir: str):
                 yield os.path.join(root, f)
 
 
-def summarize_trace(logdir: str, top: int = 25, device_only: bool = True):
+class TraceSummary(tuple):
+    """``summarize_trace`` result: unpacks as the historical 2-tuple
+    ``(rows, total_ms)`` (every existing caller does ``rows, total = ...``)
+    while additionally exposing ``n_devices`` — the device-lane count the
+    totals were averaged over. A plain 3-tuple would silently break those
+    unpack sites, hence the subclass."""
+
+    def __new__(cls, rows, total_ms, n_devices):
+        self = super().__new__(cls, (rows, total_ms))
+        self.n_devices = n_devices
+        return self
+
+    @property
+    def rows(self):
+        return self[0]
+
+    @property
+    def total_ms(self):
+        return self[1]
+
+
+def summarize_trace(logdir: str, top: int = 25, device_only: bool = True,
+                    n_devices: int | None = None):
     """Aggregate op durations from the newest trace under ``logdir``.
 
-    Returns ``(rows, total_ms)``: rows are dicts sorted by total time —
-    ``{"op": base name (trailing .N stripped), "total_ms", "count",
-    "mean_us"}`` — and ``total_ms`` sums EVERY op (not just the top rows).
-    ``device_only`` keeps only TPU/GPU device lanes (falling back to all
-    processes when none exist, e.g. CPU-backend traces). Within a process,
-    only the "XLA Ops" lanes count when present; name-scope/source/python
-    mirror lanes are excluded — they repeat each op's duration and would
-    double-count. Container events (jit_<fn>, while bodies, lane-summary
-    rows) are excluded so the total is leaf op time.
+    Returns a ``TraceSummary`` — unpacks as ``(rows, total_ms)``: rows are
+    dicts sorted by total time — ``{"op": base name (trailing .N stripped),
+    "total_ms", "count", "mean_us"}`` — and ``total_ms`` sums EVERY op (not
+    just the top rows). ``device_only`` keeps only TPU/GPU device lanes
+    (falling back to all processes when none exist, e.g. CPU-backend
+    traces). Within a process, only the "XLA Ops" lanes count when present;
+    name-scope/source/python mirror lanes are excluded — they repeat each
+    op's duration and would double-count. Container events (jit_<fn>, while
+    bodies, lane-summary rows) are excluded so the total is leaf op time.
+
+    MULTI-DEVICE: a sharded computation logs each op once PER DEVICE LANE,
+    so the raw sum counts every chip's copy — N× the per-device time a
+    single-lane trace reports (the historical behavior; it once inflated
+    sharded ms/step 8× on the CPU mesh). Totals and counts are therefore
+    divided by the lane count: one TPU/GPU device process per chip when
+    the trace has named device processes, else ``n_devices`` if passed
+    (CPU-backend traces put all virtual devices in ONE process, so the
+    caller must say — e.g. ``mesh.size``), else 1. The divisor used is
+    exposed as ``.n_devices`` on the result.
     """
     import collections
     import gzip
@@ -191,6 +223,8 @@ def summarize_trace(logdir: str, top: int = 25, device_only: bool = True):
         p for p, n in procs.items()
         if "TPU" in n or "GPU" in n or "/device" in n.lower()
     }
+    if n_devices is None:
+        n_devices = len(dev_pids) if dev_pids else 1
     if not dev_pids or not device_only:
         dev_pids = set(procs) or {e.get("pid") for e in events}
 
@@ -227,14 +261,17 @@ def summarize_trace(logdir: str, top: int = 25, device_only: bool = True):
         base = re.sub(r"\.\d+$", "", name)
         total[base] += e.get("dur", 0)
         count[base] += 1
+    n = max(int(n_devices), 1)
     rows = [
         {
             "op": op,
-            "total_ms": round(us / 1e3, 3),
-            "count": count[op],
+            "total_ms": round(us / n / 1e3, 3),
+            # per-device execution count; fractional only if lanes disagree
+            "count": count[op] // n if count[op] % n == 0
+            else round(count[op] / n, 2),
             "mean_us": round(us / max(count[op], 1), 1),
         }
         for op, us in total.most_common(top)
     ]
     grand = sum(total.values())
-    return rows, round(grand / 1e3, 3)
+    return TraceSummary(rows, round(grand / n / 1e3, 3), n)

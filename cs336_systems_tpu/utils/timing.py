@@ -131,6 +131,25 @@ def timed_total(fn: Callable, *args, warmup: int = 2, iters: int = 10, **kw):
     )
 
 
+def emit_row(row: dict, out_path: str | None = None) -> None:
+    """Flush one completed sweep cell immediately.
+
+    Long profiler-based sweeps print nothing until the end (CLAUDE.md) —
+    a crashed or stuck run then loses every finished cell. This prints the
+    row as one compact line (flush=True, so it survives a pipe) and, when
+    ``out_path`` is given, appends it as a JSON line — each cell is durable
+    the moment it completes, and the JSONL replays into ``results_table``.
+    """
+    import json
+
+    print("  " + " ".join(f"{k}={v}" for k, v in row.items() if v is not None),
+          flush=True)
+    if out_path:
+        with open(out_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+
+
 def error_cell(e: Exception) -> str:
     """Uniform error-row format for benchmark sweeps (keep the message:
     an OOM and a shape bug must be distinguishable from the table)."""

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from cs336_systems_tpu.models.transformer import config_for_size
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 from cs336_systems_tpu.train import init_train_state, make_train_loop
-from cs336_systems_tpu.utils.timing import timed_total
+from cs336_systems_tpu.utils.timing import emit_row, timed_total
 from bench import V5E_BF16_PEAK_FLOPS, model_flops_per_token
 
 # bench.py's MFU denominator (v5e bf16 chip peak) — shared, not redeclared,
@@ -65,6 +65,9 @@ def main() -> None:
     p.add_argument("--ctx", type=int, default=512)
     p.add_argument("--steps", type=int, default=5, help="in-jit loop length")
     p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--out", default=None,
+                   help="append this cell as a JSON line (one process per "
+                        "cell → the JSONL accumulates the sweep)")
     args = p.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -119,8 +122,19 @@ def main() -> None:
         f"{'+ffn-remat' if args.ffn_remat else ''} {args.dispatch}: "
         f"{ms_step:.1f} ms/step  {tok_s:,.0f} tok/s  "
         f"{gf_model:.3f} GF/tok  "
-        f"exec {tok_s * gf_exec / 1e3:.1f} TFLOP/s  {mfu * 100:.1f}% MFU"
+        f"exec {tok_s * gf_exec / 1e3:.1f} TFLOP/s  {mfu * 100:.1f}% MFU",
+        flush=True,
     )
+    if args.out:
+        emit_row({
+            "tag": tag, "dispatch": args.dispatch, "ctx": args.ctx,
+            "batch": batch, "cf": args.cf, "remat": args.remat,
+            "ffn_remat": args.ffn_remat, "steps": steps,
+            "ms_per_step": round(ms_step, 2), "tokens_per_s": round(tok_s, 1),
+            "gflops_per_token": round(gf_model, 3),
+            "exec_tflops": round(tok_s * gf_exec / 1e3, 2),
+            "mfu": round(mfu, 4),
+        }, args.out)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,15 @@ python -m cs336_systems_tpu.analysis.lint
 lint_status=$?
 [ "$status" -eq 0 ] && status=$lint_status
 
+# tracekit gate: one measured StepProfile end to end (trace -> HLO join ->
+# phase x class attribution -> MFU) on the hermetic CPU mesh. Catches
+# profiler/HLO-name drift that the static lint cannot see.
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.trace_cli --step train_single \
+    --iters 1 --out /tmp/trace_smoke.stepprofile.json
+trace_status=$?
+[ "$status" -eq 0 ] && status=$trace_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

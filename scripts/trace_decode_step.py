@@ -1,38 +1,38 @@
-"""Trace the batched KV-cache decode scan and print the device-time
-breakdown per generated token.
+"""Trace the batched KV-cache decode scan and print the phase-attributed
+device-time breakdown per generated token (a tracekit StepProfile).
 
-Same measurement recipe as trace_headline_step.py (device-lane durations
-only). Attributes the gap between the decode artifact's device_est and the
-analytic HBM roofline (results/decode_v5e.txt). The round-3-continuation
-optimization arc this script steered: 2064 us/token (XLA masked softmax +
-per-token param slices) -> 1518 (fused kernel + unstacked params) -> 1070
-(packed in-place kernel) -> 792 with approx sampling, vs roofline 664.
+Thin wrapper over ``analysis/tracekit.profile_callable`` at the serving
+shape (b32, 64-token prompts, 128 new tokens on TPU). The phase rows
+separate kv-update (the fused update+attend kernel) from the projections
+(fwd-attn), the FFN and sampling — the attribution behind the
+2064 → 792 us/token decode arc (results/decode_v5e.txt); the written
+StepProfile diffs across runs via ``trace_cli --diff``.
 
-Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_decode_step.py [logdir] [--batch N] [--approx-top-k]
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_decode_step.py \
+          [--batch N] [--approx-top-k] [--out decode.stepprofile.json]
 """
 
+import argparse
 
 from cs336_systems_tpu.utils.platform import honor_cpu_request
 
 honor_cpu_request()
 
 import jax
-import jax.numpy as jnp
 
+from cs336_systems_tpu.analysis import tracekit
+from cs336_systems_tpu.analysis.flops import decode_flops_per_token
 from cs336_systems_tpu.models.decode import generate_kv_batched
 from cs336_systems_tpu.models.transformer import config_for_size, init_transformer_lm
-from cs336_systems_tpu.utils.profiling import summarize_trace, trace
 
 
 def main() -> None:
-    import argparse
-
     ap = argparse.ArgumentParser()
-    ap.add_argument("logdir", nargs="?", default="/tmp/decode_trace")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--approx-top-k", action="store_true")
+    ap.add_argument("--out", default="decode.stepprofile.json",
+                    help="StepProfile JSON path")
     args = ap.parse_args()
-    logdir = args.logdir
     on_tpu = jax.default_backend() == "tpu"
     batch, prompt, new = (32, 64, 128) if on_tpu else (2, 8, 8)
     if args.batch is not None:
@@ -47,26 +47,25 @@ def main() -> None:
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt), 0, cfg.vocab_size)
 
-    def run():
-        toks = generate_kv_batched(
-            params, cfg, ids, new, jax.random.PRNGKey(2),
+    def gen(params, ids, key):
+        return generate_kv_batched(
+            params, cfg, ids, new, key,
             temperature=0.8, top_k=50, approx_top_k=args.approx_top_k,
         )
-        jax.device_get(toks)
 
-    run()  # compile + warm
-    with trace(logdir):
-        run()
-
-    rows, total = summarize_trace(logdir, top=30)
-    print(f"trace: {logdir}   leaf device time {total / new * 1000:.1f} us/token"
-          f"   ({total:.1f} ms total, {new} tokens, batch {batch})")
-    print(f"{'op':40s} {'us/token':>9s} {'count':>7s} {'mean_us':>9s}")
-    for r in rows:
-        print(
-            f"{r['op'][:40]:40s} {r['total_ms'] / new * 1000:9.1f} "
-            f"{r['count']:7d} {r['mean_us']:9.1f}"
-        )
+    profile = tracekit.profile_callable(
+        gen, (params, ids, jax.random.PRNGKey(2)), iters=1,
+        tokens_per_step=batch * new,
+        flops_per_token=decode_flops_per_token(
+            cfg, attend_len=min(prompt + new, cfg.context_length)),
+        family="decode_batched",
+    )
+    print(tracekit.format_profile(profile))
+    us_tok = profile["total_device_ms_per_step"] / new * 1e3
+    print(f"  per generated token: {us_tok:.1f} us "
+          f"({new} tokens, batch {batch})")
+    tracekit.write_profile(profile, args.out)
+    print(f"wrote {args.out}")
 
 
 if __name__ == "__main__":

@@ -1,15 +1,17 @@
-"""Trace the headline train step on the current backend and print the
-device-time breakdown.
+"""Trace the headline train step and print the phase-attributed
+device-time breakdown (a tracekit StepProfile).
 
-This packages the measurement recipe CLAUDE.md mandates for this runtime
-(host wall-clocks are dispatch-bound; trust device-lane durations): run the
-10-step in-jit loop once for compile, trace a second run, and summarize the
-leaf-op totals via ``utils.profiling.summarize_trace``.
+Thin wrapper over ``analysis/tracekit.profile_callable`` at the HEADLINE
+shape — the small model, ctx 512, batch 48, the 10-step in-jit loop — the
+one config ``analysis/trace_cli`` (tiny lint-registry shapes) does not
+cover. The StepProfile JSON it writes diffs against any other run via
+``trace_cli --diff`` (the packaged "compare traces, not walls").
 
-Usage: PYTHONPATH=. python scripts/trace_headline_step.py [logdir]
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_headline_step.py \
+          [--out headline.stepprofile.json]
 """
 
-import sys
+import argparse
 
 from cs336_systems_tpu.utils.platform import honor_cpu_request
 
@@ -18,14 +20,19 @@ honor_cpu_request()
 import jax
 import jax.numpy as jnp
 
+from cs336_systems_tpu.analysis import tracekit
+from cs336_systems_tpu.analysis.flops import model_flops_per_token
 from cs336_systems_tpu.models.transformer import config_for_size
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 from cs336_systems_tpu.train import init_train_state, make_train_loop
-from cs336_systems_tpu.utils.profiling import summarize_trace, trace
 
 
 def main() -> None:
-    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/headline_trace"
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="headline.stepprofile.json",
+                    help="StepProfile JSON path")
+    args = ap.parse_args()
+
     on_tpu = jax.default_backend() == "tpu"
     steps = 10 if on_tpu else 2
     batch = 48 if on_tpu else 2  # keep in lockstep with bench.py (the headline peak)
@@ -37,26 +44,24 @@ def main() -> None:
         scan_layers=not on_tpu,
     )
     params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
-    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))
+    # donate=False: the traced call repeats on the same buffers
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4), donate=False)
     xs = jax.random.randint(
         jax.random.PRNGKey(1), (steps, batch, 512), 0, cfg.vocab_size
     )
     ys = jnp.roll(xs, -1, axis=-1)
 
-    params, opt, losses = loop(params, opt, xs, ys)  # compile + warm
-    float(losses[-1])
-    with trace(logdir):
-        params, opt, losses = loop(params, opt, xs, ys)
-        float(losses[-1])
-
-    rows, total = summarize_trace(logdir)
-    print(f"trace: {logdir}   leaf device time {total / steps:.1f} ms/step")
-    print(f"{'op':32s} {'ms/step':>9s} {'count':>7s} {'mean_us':>9s}")
-    for r in rows:
-        print(
-            f"{r['op'][:32]:32s} {r['total_ms'] / steps:9.3f} "
-            f"{r['count']:7d} {r['mean_us']:9.1f}"
-        )
+    profile = tracekit.profile_callable(
+        loop, (params, opt, xs, ys), iters=1,
+        tokens_per_step=batch * 512 * steps,  # one call = `steps` steps
+        flops_per_token=model_flops_per_token(cfg),
+        family="headline_loop",
+    )
+    print(tracekit.format_profile(profile))
+    per_step = profile["total_device_ms_per_step"] / steps
+    print(f"  per optimizer step: {per_step:.1f} ms ({steps}-step loop)")
+    tracekit.write_profile(profile, args.out)
+    print(f"wrote {args.out}")
 
 
 if __name__ == "__main__":

@@ -1,16 +1,16 @@
 """Trace the MoE train step (E8k2 sorted peak cell of results/moe_v5e.txt)
-and print the device-time breakdown per op.
+and print the phase-attributed device-time breakdown (tracekit).
 
-Same measurement recipe as trace_headline_step.py (CLAUDE.md: host
-wall-clocks are dispatch-bound on this runtime; trust device-lane totals):
-compile+warm a multi-step in-jit loop once, trace a second run, summarize
-leaf-op totals. This is the per-op attribution behind the MoE MFU work —
-the round-3 artifact *inferred* "XLA scatter/gather, not FLOPs" from the
-dense/sorted split; this script measures it directly.
+Thin wrapper over ``analysis/tracekit.profile_callable`` at the MoE bench
+shapes. The phase rows give routing its own line (router matmul + softmax
++ the _prefix_count bookkeeping) next to fwd-attn/fwd-ffn/bwd — the
+attribution the round-3 artifact could only infer from the dense/sorted
+split. The written StepProfile diffs across dispatch schemes or rounds
+via ``trace_cli --diff``.
 
 Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_moe_step.py \
-          [--dispatch sorted|sorted_scatter|dense] [--batch 16] \
-          [--ffn-remat] [--logdir DIR]
+          [--dispatch sorted|sorted_scatter|dense|gmm] [--batch 16] \
+          [--ffn-remat] [--out moe.stepprofile.json]
 """
 
 import argparse
@@ -22,10 +22,11 @@ honor_cpu_request()
 import jax
 import jax.numpy as jnp
 
+from cs336_systems_tpu.analysis import tracekit
+from cs336_systems_tpu.analysis.flops import model_flops_per_token
 from cs336_systems_tpu.models.transformer import config_for_size
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 from cs336_systems_tpu.train import init_train_state, make_train_loop
-from cs336_systems_tpu.utils.profiling import summarize_trace, trace
 
 
 def main() -> None:
@@ -39,15 +40,19 @@ def main() -> None:
     p.add_argument("--ffn-remat", action="store_true")
     p.add_argument("--d-ff", type=int, default=None)
     p.add_argument("--cf", type=float, default=1.25)
-    p.add_argument("--logdir", default="/tmp/moe_trace")
+    p.add_argument("--out", default="moe.stepprofile.json",
+                   help="StepProfile JSON path")
     args = p.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    steps = args.steps if on_tpu else 2
+    # CPU smoke: the 125M MoE step in float32 is minutes per trace at the
+    # bench shapes — shrink to one short-context step (same code paths).
+    steps = args.steps if on_tpu else 1
     batch = args.batch if on_tpu else 2
+    ctx = 512 if on_tpu else 256
     cfg = config_for_size(
         "small",
-        context_length=512,
+        context_length=ctx,
         compute_dtype="bfloat16" if on_tpu else "float32",
         attn_impl="flash" if on_tpu else "xla",
         scan_layers=not on_tpu,
@@ -59,31 +64,26 @@ def main() -> None:
         **({"d_ff": args.d_ff} if args.d_ff else {}),
     )
     params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
-    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4))
+    # donate=False: the traced call repeats on the same buffers
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4), donate=False)
     xs = jax.random.randint(
-        jax.random.PRNGKey(1), (steps, batch, 512), 0, cfg.vocab_size
+        jax.random.PRNGKey(1), (steps, batch, ctx), 0, cfg.vocab_size
     )
     ys = jnp.roll(xs, -1, axis=-1)
 
-    params, opt, losses = loop(params, opt, xs, ys)  # compile + warm
-    float(losses[-1])
-    with trace(args.logdir):
-        params, opt, losses = loop(params, opt, xs, ys)
-        float(losses[-1])
-
-    rows, total = summarize_trace(args.logdir)
-    tokens = batch * 512
-    print(
-        f"dispatch={args.dispatch} E{args.experts}k{args.top_k} b{batch}: "
-        f"leaf device time {total / steps:.1f} ms/step "
-        f"({tokens * steps / (total / 1e3):,.0f} tok/s device-bound)"
+    profile = tracekit.profile_callable(
+        loop, (params, opt, xs, ys), iters=1,
+        tokens_per_step=batch * ctx * steps,  # one call = `steps` steps
+        flops_per_token=model_flops_per_token(cfg),
+        family=f"moe_{args.dispatch}_E{args.experts}k{args.top_k}_b{batch}",
     )
-    print(f"{'op':40s} {'ms/step':>9s} {'count':>7s} {'mean_us':>9s}")
-    for r in rows[:40]:
-        print(
-            f"{r['op'][:40]:40s} {r['total_ms'] / steps:9.3f} "
-            f"{r['count']:7d} {r['mean_us']:9.1f}"
-        )
+    print(tracekit.format_profile(profile))
+    per_step = profile["total_device_ms_per_step"] / steps
+    tok_s = batch * ctx / (per_step / 1e3) if per_step else 0.0
+    print(f"  per optimizer step: {per_step:.1f} ms "
+          f"({tok_s:,.0f} tok/s device-bound)")
+    tracekit.write_profile(profile, args.out)
+    print(f"wrote {args.out}")
 
 
 if __name__ == "__main__":

@@ -147,6 +147,50 @@ def test_barrier_stripped_moe_flagged(monkeypatch):
     assert "47.9 ms/step" in vs[0].message
 
 
+# --- phase scopes (tracekit instrumentation) --------------------------------
+
+
+def test_phase_scope_rule_direct():
+    """The rule fires on a scope-less program and passes a scoped one —
+    including the ``transpose(`` marker AD stamps on backward ops."""
+
+    def plain(x):
+        return jnp.sum(x * 2)
+
+    jaxpr = jax.make_jaxpr(plain)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    vs = contracts.check_phase_scopes("t", jaxpr, ("attn",))
+    assert _rules(vs) == {"phase-scope"}
+    assert "'attn'" in vs[0].message and "other" in vs[0].message
+
+    def scoped(x):
+        with jax.named_scope("attn"):
+            return jnp.sum(x * 2)
+
+    jaxpr = jax.make_jaxpr(scoped)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert contracts.check_phase_scopes("t", jaxpr, ("attn",)) == []
+    # AD's transpose(jvp(...)) stack satisfies the bwd marker w/o annotation
+    jaxpr = jax.make_jaxpr(jax.grad(scoped))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert contracts.check_phase_scopes("t", jaxpr,
+                                        ("attn", "transpose(")) == []
+
+
+def test_phase_scope_mutation_flagged(monkeypatch):
+    """Stripping train.make_update_fn's annotate("optimizer") scope — the
+    exact rot the rule exists for — must trip phase-scope on the same
+    train_single build that passes annotated."""
+    import contextlib
+
+    from cs336_systems_tpu import train as train_mod
+
+    monkeypatch.setattr(train_mod, "annotate",
+                        lambda name: contextlib.nullcontext())
+    spec = next(s for s in registry.STEPS if s.name == "train_single")
+    vs = lint_step("train_single", spec.build())
+    assert "phase-scope" in _rules(vs)
+    assert "optimizer" in " ".join(v.message for v in vs)
+
+
 # --- fp32 big dots ----------------------------------------------------------
 
 

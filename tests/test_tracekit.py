@@ -1,0 +1,322 @@
+"""tracekit tests: taxonomy classification, phase attribution, the
+multi-device trace-total fix, diff thresholds, and a CPU smoke of
+``trace_cli --step`` for one train and one serve family.
+
+Same oracle discipline as test_analysis.py: the classifier and the diff
+gate are tested against hand-built known inputs (synthetic HLO text and
+synthetic ``.trace.json.gz`` fixtures), not assumed correct; the smoke
+tests then check the full pipeline end to end on the hermetic CPU mesh.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from cs336_systems_tpu.analysis import tracekit
+from cs336_systems_tpu.analysis.tracekit import (
+    HloOp,
+    attribute,
+    classify_op,
+    diff_profiles,
+    parse_hlo_ops,
+    phase_of,
+    read_trace_events,
+)
+
+
+# --- HLO parsing ------------------------------------------------------------
+
+
+_HLO_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={...}
+
+%fused_computation (p: f32[8,8]) -> f32[8,8] {
+  %p = f32[8,8]{1,0} parameter(0)
+  ROOT %mul.9 = f32[8,8]{1,0} multiply(%p, %p), metadata={op_name="jit(step)/fwd/ffn/silu"}
+}
+
+ENTRY %main (a: f32[8,8], b: f32[8,8]) -> f32[8,8] {
+  %a = f32[8,8]{1,0} parameter(0)
+  %b = f32[8,8]{1,0} parameter(1)
+  %dot.1 = f32[8,8]{1,0} dot(%a, %b), metadata={op_name="jit(step)/fwd/attn/qkv_proj/dot_general" source_file="m.py"}
+  %dot.2 = f32[8,8]{1,0} dot(%a, %b), metadata={op_name="jit(step)/transpose(jvp(step))/attn/dot_general"}
+  %copy.3 = f32[8,8]{1,0} copy(%dot.1), metadata={op_name="jit(step)/fwd/attn/rope"}
+  %fusion.4 = f32[8,8]{1,0} fusion(%copy.3), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(step)/fwd/ffn/silu"}
+  %all-reduce-start.5 = f32[8,8]{1,0} all-reduce-start(%fusion.4), metadata={op_name="jit(step)/optimizer/psum"}
+  %all-reduce-done.6 = f32[8,8]{1,0} all-reduce-done(%all-reduce-start.5)
+  %custom-call.7 = f32[8,8]{1,0} custom-call(%a), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/fwd/attn/sdpa/pallas_call"}
+  %while.8 = f32[8,8]{1,0} while(%dot.1), condition=%cond, body=%body
+  ROOT %add.9 = f32[8,8]{1,0} add(%dot.2, %fusion.4), metadata={op_name="jit(step)/blk/ffn/residual"}
+}
+"""
+
+
+def test_parse_hlo_ops_all_computations():
+    ops = parse_hlo_ops(_HLO_FIXTURE)
+    assert ops["dot.1"].opcode == "dot"
+    assert ops["dot.1"].scope == "jit(step)/fwd/attn/qkv_proj/dot_general"
+    assert ops["custom-call.7"].call_target == "tpu_custom_call"
+    assert ops["while.8"].opcode == "while"
+    # non-ENTRY computations are parsed too (their ops trace as events)
+    assert ops["mul.9"].opcode == "multiply"
+    # metadata-free ops still parse, with an empty scope
+    assert ops["all-reduce-done.6"].scope == ""
+
+
+# --- taxonomy ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op,expected", [
+    (HloOp("dot", ""), "mxu-matmul"),
+    (HloOp("convolution", ""), "mxu-matmul"),
+    (HloOp("fusion", "fwd/ffn"), "vpu-elementwise"),
+    (HloOp("add", ""), "vpu-elementwise"),
+    (HloOp("copy", ""), "copy-transpose"),
+    (HloOp("dynamic-update-slice", ""), "copy-transpose"),
+    (HloOp("all-reduce", ""), "collective-all-reduce"),
+    (HloOp("all-reduce-start", ""), "collective-all-reduce"),
+    (HloOp("all-reduce-done", ""), "dma"),
+    (HloOp("all-to-all", ""), "collective-all-to-all"),
+    (HloOp("copy-start", ""), "dma"),
+    (HloOp("custom-call", "", "tpu_custom_call"), "pallas-kernel"),
+    (HloOp("custom-call", "", "MosaicKernel"), "pallas-kernel"),
+    (HloOp("custom-call", "fwd/attn/pallas_call", ""), "pallas-kernel"),
+    (HloOp("custom-call", "", "xla_ffi_something"), "host"),
+    (HloOp("parameter", ""), "host"),
+    (HloOp("get-tuple-element", ""), "host"),
+])
+def test_classify_op(op, expected):
+    assert classify_op(op) == expected
+
+
+# --- phase attribution ------------------------------------------------------
+
+
+@pytest.mark.parametrize("scope,expected", [
+    ("", "other"),
+    ("jit(step)/fwd/attn/qkv_proj/dot_general", "fwd-attn"),
+    ("jit(step)/blk0/attn/rope", "fwd-attn"),
+    ("jit(step)/blk0/ffn/silu", "fwd-ffn"),
+    ("jit(step)/lm_head/dot_general", "fwd-ffn"),
+    # AD's transpose( marker beats the forward scope it wraps
+    ("jit(step)/transpose(jvp(step))/attn/dot_general", "bwd"),
+    ("jit(step)/optimizer/adamw/mul", "optimizer"),
+    # inner scopes win where they nest
+    ("generate/blk0/attn/kv_update/dynamic-update-slice", "kv-update"),
+    ("generate/blk0/ffn/routing/softmax", "routing"),
+    ("generate/sampling/top_k", "sampling"),
+    ("jit(step)/some/unrelated/scope", "other"),
+])
+def test_phase_of(scope, expected):
+    assert phase_of(scope) == expected
+
+
+# --- attribution over synthetic events --------------------------------------
+
+
+def _ev(name, dur, pid=1, tid=1):
+    return {"ph": "X", "pid": pid, "tid": tid, "ts": 0, "dur": dur,
+            "name": name}
+
+
+def test_attribute_joins_skips_and_divides():
+    op_map = parse_hlo_ops(_HLO_FIXTURE)
+    events = [
+        _ev("dot.1", 100), _ev("dot.1", 100),        # 2 execs, fwd-attn mxu
+        _ev("dot.2", 300), _ev("dot.2", 300),        # bwd mxu
+        _ev("fusion.4", 50), _ev("fusion.4", 50),    # fwd-ffn vpu
+        _ev("while.8", 9999), _ev("while.8", 9999),  # container: skipped
+        _ev("a", 500),                               # parameter/host: skipped
+        _ev("not_an_instruction", 700),              # no HLO join: skipped
+    ]
+    phase_class, rows = attribute(events, op_map, divisor=2.0)
+    assert phase_class["fwd-attn"]["mxu-matmul"] == 200
+    assert phase_class["bwd"]["mxu-matmul"] == 600
+    assert phase_class["fwd-ffn"]["vpu-elementwise"] == 100
+    assert "other" not in phase_class  # the container/host time never lands
+    by_op = {r["op"]: r for r in rows}
+    assert by_op["dot.1"]["total_ms"] == pytest.approx(0.1)
+    assert by_op["dot.1"]["count"] == 1  # 2 events / divisor 2
+    assert by_op["dot.2"]["phase"] == "bwd"
+    assert "while.8" not in by_op and "a" not in by_op
+    assert rows[0]["op"] == "dot.2"  # sorted by time
+
+
+# --- trace reading: noise lanes ---------------------------------------------
+
+
+def _write_trace(tmp_path, events):
+    path = os.path.join(tmp_path, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return tmp_path
+
+
+def test_read_trace_events_drops_noise_lanes(tmp_path):
+    events = [
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "Framework Name Scope"}},
+        _ev("dot.1", 100, tid=1),
+        _ev("fwd/attn", 100, tid=2),  # name-scope mirror lane: dropped
+    ]
+    got = read_trace_events(_write_trace(str(tmp_path), events))
+    assert [e["name"] for e in got] == ["dot.1"]
+
+
+# --- satellite: summarize_trace multi-device fix ----------------------------
+
+
+def test_summarize_trace_divides_by_device_lanes(tmp_path):
+    """Two device processes each logging the same op once: the historical
+    behavior summed both lanes (2x the per-device time); the fixed version
+    reports the per-device mean and exposes the divisor."""
+    from cs336_systems_tpu.utils.profiling import summarize_trace
+
+    events = []
+    for pid in (1, 2):
+        events += [
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": f"/device:TPU:{pid - 1}"}},
+            {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            _ev("dot.1", 500, pid=pid),
+            _ev("dot.1", 500, pid=pid),
+        ]
+    res = summarize_trace(_write_trace(str(tmp_path), events))
+    rows, total = res  # the historical 2-tuple unpack must keep working
+    assert res.n_devices == 2
+    assert total == pytest.approx(1.0)      # 2000 us / 2 lanes, not 2.0
+    assert rows[0]["total_ms"] == pytest.approx(1.0)
+    assert rows[0]["count"] == 2            # per-device executions
+    assert rows[0]["mean_us"] == pytest.approx(500.0)
+
+
+def test_summarize_trace_single_lane_unchanged(tmp_path):
+    from cs336_systems_tpu.utils.profiling import summarize_trace
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        _ev("dot.1", 500),
+    ]
+    rows, total = summarize_trace(_write_trace(str(tmp_path), events))
+    assert total == pytest.approx(0.5)
+    assert rows[0]["count"] == 1
+
+
+def test_summarize_trace_explicit_n_devices(tmp_path):
+    """CPU-backend traces put all virtual devices in one process; the
+    caller passes mesh.size and the division still happens."""
+    from cs336_systems_tpu.utils.profiling import summarize_trace
+
+    events = [
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        _ev("dot.1", 400),
+        _ev("dot.1", 400),
+    ]
+    res = summarize_trace(_write_trace(str(tmp_path), events), n_devices=2)
+    assert res.n_devices == 2
+    assert res.total_ms == pytest.approx(0.4)
+
+
+# --- diffing ----------------------------------------------------------------
+
+
+def _profile(total, phases, classes, family="fam"):
+    return {
+        "schema": tracekit.SCHEMA, "family": family,
+        "total_device_ms_per_step": total,
+        "phase_ms": phases, "class_ms": classes,
+    }
+
+
+def test_diff_identical_flags_nothing():
+    a = _profile(10.0, {"fwd-attn": 6.0, "bwd": 4.0}, {"mxu-matmul": 10.0})
+    d = diff_profiles(a, dict(a))
+    assert d["n_flagged"] == 0
+    assert d["total_delta_ms"] == 0.0
+
+
+def test_diff_flags_real_regression_only():
+    a = _profile(10.0, {"fwd-attn": 6.0, "bwd": 4.0}, {"mxu-matmul": 10.0})
+    b = _profile(13.0, {"fwd-attn": 9.0, "bwd": 4.0}, {"mxu-matmul": 13.0})
+    d = diff_profiles(a, b, threshold_pct=10.0, abs_floor_ms=0.05)
+    flagged = {(r["kind"], r["key"]) for r in d["rows"] if r["flagged"]}
+    assert flagged == {("phase", "fwd-attn"), ("class", "mxu-matmul")}
+
+
+def test_diff_abs_floor_gates_noise():
+    """An 80% swing on a 50 us phase is lane jitter, not a regression —
+    the absolute floor must keep it quiet."""
+    a = _profile(0.05, {"sampling": 0.05}, {"vpu-elementwise": 0.05})
+    b = _profile(0.09, {"sampling": 0.09}, {"vpu-elementwise": 0.09})
+    assert diff_profiles(a, b)["n_flagged"] == 0
+
+
+def test_diff_new_phase_flagged():
+    a = _profile(1.0, {"fwd-attn": 1.0}, {"mxu-matmul": 1.0})
+    b = _profile(2.0, {"fwd-attn": 1.0, "routing": 1.0},
+                 {"mxu-matmul": 1.0, "vpu-elementwise": 1.0})
+    d = diff_profiles(a, b)
+    new = [r for r in d["rows"] if r["key"] == "routing"][0]
+    assert new["flagged"] and new["delta_pct"] is None
+
+
+def test_diff_family_mismatch_raises():
+    a = _profile(1.0, {}, {}, family="train_single")
+    b = _profile(1.0, {}, {}, family="serve_dp")
+    with pytest.raises(ValueError, match="different families"):
+        diff_profiles(a, b)
+
+
+# --- end-to-end CPU smoke ---------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["train_single", "serve_dp"])
+def test_trace_cli_step_smoke(family, tmp_path):
+    """The acceptance path: trace_cli --step writes a StepProfile with a
+    non-empty phase x class breakdown and an MFU estimate, exit 0."""
+    from cs336_systems_tpu.analysis import trace_cli
+
+    out = str(tmp_path / f"{family}.json")
+    assert trace_cli.main(["--step", family, "--iters", "1",
+                           "--out", out]) == 0
+    with open(out) as f:
+        p = json.load(f)
+    assert p["schema"] == tracekit.SCHEMA
+    assert p["family"] == family
+    assert p["total_device_ms_per_step"] > 0
+    assert p["phase_class_ms"] and any(
+        c for c in p["phase_class_ms"].values())
+    assert p["mfu"] > 0 and p["achieved_tflops"] > 0
+    assert p["ops"], "top op rows must be populated"
+    if family == "train_single":
+        # the canonical step must attribute real time to its core phases
+        for ph in ("fwd-attn", "bwd", "optimizer"):
+            assert p["phase_ms"].get(ph, 0) > 0, ph
+    else:
+        for ph in ("kv-update", "sampling"):
+            assert p["phase_ms"].get(ph, 0) > 0, ph
+
+
+def test_trace_cli_diff_identical_exits_zero(tmp_path):
+    from cs336_systems_tpu.analysis import trace_cli
+
+    p = _profile(10.0, {"fwd-attn": 6.0}, {"mxu-matmul": 6.0})
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    for path in (a, b):
+        with open(path, "w") as f:
+            json.dump(p, f)
+    assert trace_cli.main(["--diff", a, b]) == 0
+
+    worse = _profile(20.0, {"fwd-attn": 12.0}, {"mxu-matmul": 12.0})
+    with open(b, "w") as f:
+        json.dump(worse, f)
+    assert trace_cli.main(["--diff", a, b]) == 1  # CI-gateable
