@@ -118,6 +118,21 @@ def main() -> None:
         line["mfu"] = prof["mfu"]
     except Exception:  # noqa: BLE001 — telemetry is additive, never fatal
         pass
+    try:
+        # static memory account of the same loop (analysis/memkit): the
+        # per-device liveness-analyzed peak over the optimized HLO — the
+        # number that says how much batch/ctx headroom the headline step
+        # has left. Same degradation contract as mfu: additive, never
+        # fatal to the one-JSON-line output.
+        from cs336_systems_tpu.analysis import memkit
+        from cs336_systems_tpu.train import make_train_loop as _mtl2
+
+        line["peak_hbm_bytes"] = memkit.profile_callable(
+            _mtl2(cfg, AdamWHparams(lr=3e-4), donate=False),
+            (params, opt_state, xs, ys), family="headline_loop",
+        )["peak_bytes"]
+    except Exception:  # noqa: BLE001 — telemetry is additive, never fatal
+        pass
     print(json.dumps(line))
 
 

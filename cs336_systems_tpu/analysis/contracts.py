@@ -217,3 +217,32 @@ def check_no_big_fp32_dots(name: str, jaxpr,
                 "and accumulate via preferred_element_type instead",
             ))
     return out
+
+
+def check_hbm_budget(name: str, budget_bytes: int) -> list[Violation]:
+    """A family declaring ``hbm_budget_bytes`` in the registry must keep
+    its memkit-analyzed per-device peak under that budget. The analyzed
+    peak tracks STRUCTURE (stashes, residuals, undonated copies), so a
+    budget trip on the tiny CPU-mesh shapes means the step's memory shape
+    changed — the class of regression that made training b48 OOM under
+    gmm (the h/g residuals) and ctx-65536 stash 25 GB without --remat."""
+    from cs336_systems_tpu.analysis import memkit
+
+    try:
+        profile = memkit.profile_family(name)
+    except Exception as e:  # noqa: BLE001 — an unanalyzable step is a finding
+        return [Violation(
+            "hbm-budget", name,
+            f"memkit failed to analyze the step: {type(e).__name__}: {e}")]
+    peak = profile.get("peak_bytes", 0)
+    if peak <= budget_bytes:
+        return []
+    worst = (profile.get("top_buffers") or [{}])[0]
+    return [Violation(
+        "hbm-budget", name,
+        f"analyzed peak {peak} bytes exceeds hbm_budget_bytes "
+        f"{budget_bytes} ({peak / budget_bytes:.2f}x; biggest live buffer "
+        f"at peak: {worst.get('name', '?')} {worst.get('bytes', 0)}B "
+        f"[{worst.get('class', '?')}]) — run mem_cli --step {name} for "
+        "the composition, or raise the registry budget if intentional",
+    )]

@@ -1,0 +1,153 @@
+"""mem_cli: phase-attributed HBM accounting for any registered step.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m cs336_systems_tpu.analysis.mem_cli --step train_single
+
+Compiles the named step family (the same tracekit bundles trace_cli
+traces, plus the headline/decode/MoE bench shapes) on the current
+backend — the hermetic 8-virtual-device CPU mesh by default, a real TPU
+with ``CS336_TPU_MEM=1`` — and writes a MemProfile JSON
+(``memprofile/v1``): the analyzed per-device peak, the live-set
+composition AT the peak attributed phase × class, a per-phase
+high-water table, the biggest live buffers, and the
+``compiled.memory_analysis()`` totals as cross-check. Pure compile-time
+analysis: nothing executes and no device memory is touched, so the TPU
+path is safe next to a running chip process.
+
+``--diff a.json b.json`` prints per-phase/per-class byte deltas with the
+same dual noise gate as trace_cli (flag only when BOTH the absolute and
+the relative threshold trip) and exits 1 on any flagged row — the
+CI-gateable "this change ate the headroom" check. ``--budget`` verifies
+every family that declares ``hbm_budget_bytes`` in the registry.
+``--explain-oom LOG`` parses a RESOURCE_EXHAUSTED log and prints demand
+vs limit vs (with ``--step``) the analyzed peak.
+
+Exit status: 0 ok, 1 findings (flagged diff rows / budget violations /
+profile failure), 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (same escape
+# hatch as trace_cli): analyzing against a real TPU backend goes through
+# CS336_TPU_MEM=1, everything else must not grab the tunneled chip.
+if not os.environ.get("CS336_TPU_MEM"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import sys
+
+import jax
+
+if not os.environ.get("CS336_TPU_MEM"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu.analysis import memkit
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cs336_systems_tpu.analysis.mem_cli",
+        description="phase-attributed MemProfile analysis, diffing, "
+                    "budgets and OOM forensics (see analysis/README.md)")
+    ap.add_argument("--step", metavar="FAMILY",
+                    help="step family to analyze (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list analyzable step families and exit")
+    ap.add_argument("--out", metavar="PATH",
+                    help="MemProfile JSON path "
+                         "(default <family>.memprofile.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print JSON to stdout instead of the human "
+                         "summary")
+    ap.add_argument("--top", type=int, default=12,
+                    help="live-buffer rows to keep (default 12)")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="diff two MemProfiles of the same family")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="diff flag threshold in %% (default 10)")
+    ap.add_argument("--abs-floor-mb", type=float, default=1.0,
+                    help="diff flag absolute floor in MiB (default 1)")
+    ap.add_argument("--budget", action="store_true",
+                    help="check families with a declared hbm_budget_bytes")
+    ap.add_argument("--explain-oom", metavar="LOGFILE",
+                    help="parse an OOM log: demand vs limit vs (with "
+                         "--step) the analyzed peak")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in memkit.family_names():
+            print(name)
+        return 0
+
+    if args.diff:
+        d = memkit.diff_memprofiles(
+            _load(args.diff[0]), _load(args.diff[1]),
+            threshold_pct=args.threshold,
+            abs_floor_bytes=int(args.abs_floor_mb * (1 << 20)))
+        print(json.dumps(d, indent=2) if args.json
+              else memkit.format_diff(d))
+        return 1 if d["n_flagged"] else 0
+
+    if args.explain_oom:
+        with open(args.explain_oom) as f:
+            log_text = f.read()
+        profile = None
+        if args.step:
+            profile = memkit.profile_family(args.step, top=args.top)
+        e = memkit.explain_oom(log_text, profile)
+        print(json.dumps(e, indent=2) if args.json
+              else memkit.format_explain(e))
+        return 0
+
+    if args.budget:
+        from cs336_systems_tpu.analysis import registry
+
+        findings = []
+        for name, budget in sorted(registry.HBM_BUDGET_BYTES.items()):
+            p = memkit.profile_family(name, top=args.top)
+            over = memkit.check_budget(p, budget)
+            pct = p["peak_bytes"] / budget * 100
+            status = over[0] if over else f"ok ({pct:.0f}% of budget)"
+            print(f"  {name:<20} {memkit._fmt_bytes(p['peak_bytes']):>12} "
+                  f"/ {memkit._fmt_bytes(budget):>10}  {status}")
+            findings += [(name, m) for m in over]
+        return 1 if findings else 0
+
+    if not args.step:
+        ap.error("one of --step, --list, --diff, --budget or "
+                 "--explain-oom is required")
+    try:
+        profile = memkit.profile_family(args.step, top=args.top)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 1
+    from cs336_systems_tpu.analysis import registry
+
+    budget = registry.HBM_BUDGET_BYTES.get(args.step)
+    if budget:
+        profile["budget_bytes"] = budget
+    out = args.out or f"{args.step}.memprofile.json"
+    memkit.write_profile(profile, out)
+    if args.json:
+        print(json.dumps(profile, indent=2))
+    else:
+        print(memkit.format_profile(profile))
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

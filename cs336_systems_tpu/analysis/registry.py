@@ -324,3 +324,19 @@ STEPS: tuple[StepSpec, ...] = (
              functools.partial(_build_serve, {"dp": 2, "tp": 4}, "dp",
                                "tp", None, True)),
 )
+
+
+# Optional per-family HBM budgets (bytes, per device) for analysis/memkit.
+# A declared family's analyzed peak must stay under its budget — checked
+# by graft-lint (contracts.check_hbm_budget) and ``mem_cli --budget`` —
+# so "this change ate the headroom" fails loud on the CPU mesh before a
+# chip run ever OOMs. Budgets are ~4x the measured analyzed peak at the
+# registry's tiny shapes (the jaxpr structure, not the widths, is what
+# regresses: an undropped stash, an undonated copy, a residual that
+# should have been recomputed). Only a small set declares one: each check
+# COMPILES the family, and lint's whole run is contractually ~10 s.
+HBM_BUDGET_BYTES: dict[str, int] = {
+    "train_single": 48 << 20,   # analyzed peak ~11.4 MB
+    "train_tp": 8 << 20,        # analyzed peak ~1.5 MB
+    "serve_dp": 2 << 20,        # analyzed peak ~0.23 MB
+}

@@ -9,7 +9,11 @@ Two measurement modes:
 
 - **Compile-time analysis (default, ``analyze_memory_cell``)** — lower the
   jitted step over abstract ``ShapeDtypeStruct`` inputs and read the XLA
-  buffer-assignment peak from ``compiled.memory_analysis()``. This is the
+  buffer-assignment peak from ``compiled.memory_analysis()``, falling back
+  to the ``analysis/memkit`` liveness reconstruction on backends whose
+  CompiledMemoryStats carries no peak counter (the CPU mesh). For the
+  phase × class composition BEHIND a peak, use
+  ``python -m cs336_systems_tpu.analysis.mem_cli``. This is the
   exact number the runtime will reserve (XLA preallocates its buffer
   assignment; there is no allocator timeline to sample on TPU the way
   ``torch.cuda.memory`` records one), it varies with ctx/phase/dtype the
@@ -34,6 +38,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from cs336_systems_tpu.analysis import memkit
+from cs336_systems_tpu.analysis.memkit import parse_oom_demand
 from cs336_systems_tpu.models.transformer import (
     MODEL_SIZES,
     config_for_size,
@@ -42,27 +48,17 @@ from cs336_systems_tpu.models.transformer import (
 from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
 from cs336_systems_tpu.train import lm_loss, make_train_step
 from cs336_systems_tpu.utils.profiling import memory_snapshot, memory_stats, peak_bytes
-from cs336_systems_tpu.utils.timing import error_cell, print_table, results_table
+from cs336_systems_tpu.utils.timing import (
+    emit_row,
+    error_cell,
+    print_table,
+    results_table,
+)
 
-
-def _parse_oom_demand(msg: str) -> tuple[float | None, float | None]:
-    """Extract (total demand bytes, HBM limit bytes) from an XLA:TPU
-    'Ran out of memory in memory space hbm' compile error. The compiler
-    prints ``Total hbm usage >= X`` (full buffer-assignment demand) and
-    ``Used X of Y hbm``; returns (None, None) when the error is not an
-    HBM-capacity failure."""
-    import re
-
-    mult = {"K": 2**10, "M": 2**20, "G": 2**30, "B": 1}
-    total = re.search(r"Total hbm usage >= ([0-9.]+)([KMGB])", msg)
-    used = re.search(r"Used ([0-9.]+)([KMGB]) of ([0-9.]+)([KMGB]) hbm", msg)
-    peak = None
-    if total:
-        peak = float(total.group(1)) * mult[total.group(2)]
-    elif used:
-        peak = float(used.group(1)) * mult[used.group(2)]
-    limit = float(used.group(3)) * mult[used.group(4)] if used else None
-    return peak, limit
+# canonical home is analysis/memkit (the OOM-forensics entry point behind
+# ``mem_cli --explain-oom``); kept under the old private name for callers
+# of the pre-memkit API
+_parse_oom_demand = parse_oom_demand
 
 
 def analyze_memory_cell(
@@ -132,17 +128,12 @@ def analyze_memory_cell(
         "backend": jax.devices()[0].platform,
     }
     try:
-        ma = lowered.compile().memory_analysis()
-        if ma is None:  # PJRT plugins may return None instead of raising
-            raise RuntimeError(
-                f"backend {jax.devices()[0].platform!r} does not implement "
-                "compiled memory analysis; use --mode runtime"
-            )
+        compiled = lowered.compile()
     except Exception as e:  # over-HBM: the TPU compiler refuses the program
         # but its error reports the full buffer-assignment demand — parse it
         # so cells that cannot fit one chip still get their true number
         # (the reference could record these: 80 GB A100 vs 16 GB v5e).
-        peak, limit = _parse_oom_demand(str(e))
+        peak, limit = parse_oom_demand(str(e))
         if peak is None:
             raise
         return {
@@ -151,13 +142,20 @@ def analyze_memory_cell(
             "limit_mb": mb(limit) if limit else None,
             "fits_hbm": False,
         }
+    stats = memkit.xla_memory_stats(compiled)
+    peak = stats.get("peak_memory_in_bytes")
+    if peak is None:
+        # the CPU backend's CompiledMemoryStats carries no peak counter
+        # (peak_memory_in_bytes is TPU-plugin-only) — the memkit liveness
+        # reconstruction over the optimized HLO is the peak everywhere
+        peak = memkit.analyze_hlo(compiled.as_text()).peak_bytes
     return {
         **cell,
-        "peak_mb": mb(ma.peak_memory_in_bytes),
-        "args_mb": mb(ma.argument_size_in_bytes),
-        "temp_mb": mb(ma.temp_size_in_bytes),
-        "out_mb": mb(ma.output_size_in_bytes),
-        "alias_mb": mb(ma.alias_size_in_bytes),
+        "peak_mb": mb(peak),
+        "args_mb": mb(stats.get("argument_size_in_bytes", 0)),
+        "temp_mb": mb(stats.get("temp_size_in_bytes", 0)),
+        "out_mb": mb(stats.get("output_size_in_bytes", 0)),
+        "alias_mb": mb(stats.get("alias_size_in_bytes", 0)),
         "fits_hbm": True,
     }
 
@@ -169,15 +167,23 @@ def run_memory_analysis(
     batch_size: int = 4,
     donate: bool = True,
     oom_ok: bool = True,
+    out_path: str | None = None,
 ):
     """Compile-time grid sweep (see module docstring); no device memory
-    needed, so every reference cell — including all of 2.7b — gets a row."""
+    needed, so every reference cell — including all of 2.7b — gets a row.
+    Each cell flushes as it completes (``--out FILE.jsonl`` makes it
+    durable) — a killed sweep keeps every finished cell."""
     rows = []
+
+    def _add(row):
+        rows.append(row)
+        emit_row(row, out_path)
+
     for ctx in context_lengths:
         for dtype in dtypes:
             for full_step in (False, True):
                 try:
-                    rows.append(
+                    _add(
                         analyze_memory_cell(
                             size, ctx, full_step, compute_dtype=dtype,
                             batch_size=batch_size, donate=donate,
@@ -186,7 +192,7 @@ def run_memory_analysis(
                 except Exception as e:
                     if not oom_ok:
                         raise
-                    rows.append(
+                    _add(
                         {"size": size, "ctx": ctx,
                          "phase": "fullstep" if full_step else "forward",
                          "dtype": dtype, "error": error_cell(e)}
@@ -278,24 +284,33 @@ def run_memory_benchmark(
     snapshot_dir: str | None = "memory_files",
     oom_ok: bool = True,
     isolate: bool = True,
+    out_path: str | None = None,
 ):
     """Grid sweep. ``isolate`` runs each cell in a fresh interpreter so the
     peak counter is per-cell-accurate (slower: pays jax init per cell);
-    ``isolate=False`` shares the process and peaks are only upper bounds."""
+    ``isolate=False`` shares the process and peaks are only upper bounds.
+    Cells flush as they complete (``--out FILE.jsonl`` makes them durable:
+    isolated cells take minutes each on the remote runtime and a killed
+    sweep loses nothing)."""
     rows = []
+
+    def _add(row):
+        rows.append(row)
+        emit_row(row, out_path)
+
     for ctx in context_lengths:
         for dtype in dtypes:
             for full_step in (False, True):
                 try:
                     if isolate:
-                        rows.append(
+                        _add(
                             _run_cell_isolated(
                                 size, ctx, full_step, dtype, batch_size,
                                 snapshot_dir,
                             )
                         )
                     else:
-                        rows.append(
+                        _add(
                             profile_memory_cell(
                                 size, ctx, full_step, compute_dtype=dtype,
                                 batch_size=batch_size, snapshot_dir=snapshot_dir,
@@ -304,7 +319,7 @@ def run_memory_benchmark(
                 except Exception as e:
                     if not oom_ok:
                         raise
-                    rows.append(
+                    _add(
                         {"size": size, "ctx": ctx,
                          "phase": "fullstep" if full_step else "forward",
                          "dtype": dtype,
@@ -335,6 +350,9 @@ def main(argv=None) -> None:
     p.add_argument("--no-donate", action="store_true",
                    help="analyze the fullstep without params/opt donation "
                         "(the no-aliasing upper bound)")
+    p.add_argument("--out", default=None, metavar="FILE.jsonl",
+                   help="append each finished cell as a JSON line (durable "
+                        "under a killed sweep; replays into results_table)")
     p.add_argument("--cell", default=None, help=argparse.SUPPRESS)  # internal
     args = p.parse_args(argv)
 
@@ -362,6 +380,7 @@ def main(argv=None) -> None:
         df = run_memory_analysis(
             size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
             batch_size=args.batch, donate=not args.no_donate,
+            out_path=args.out,
         )
     else:
         df = run_memory_benchmark(
@@ -370,6 +389,7 @@ def main(argv=None) -> None:
             snapshot_dir=(args.snapshot_dir or "memory_files")
             if args.snapshots else None,
             isolate=not args.no_isolate,
+            out_path=args.out,
         )
     print_table(df)
 

@@ -84,12 +84,17 @@ class _Layer:
     pytree the layer persists for exact resume; ``restore(ck) -> state``
     rebuilds the training state from a loaded checkpoint dict (placing
     sharded state back onto the mesh — see zero1_restore/fsdp_restore).
+
+    ``step_fn`` is the underlying jitted per-dispatch callable (the thing
+    ``run`` wraps) — telemetry reads its compile-cache size to surface
+    silent shape-driven recompiles (``recompile_count``).
     """
 
     def __init__(self, init, run, to_params, mesh, to_opt, restore,
-                 batch_spec=None):
+                 batch_spec=None, step_fn=None):
         self.init, self.run, self.to_params = init, run, to_params
         self.mesh, self.to_opt, self.restore = mesh, to_opt, restore
+        self.step_fn = step_fn
         # PartitionSpec for placing host batches ("dp" over the batch dim
         # unless a mode says otherwise — sp also shards the sequence dim)
         from jax.sharding import PartitionSpec as P
@@ -153,7 +158,8 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
                 return (params, opt), losses[-1], key
 
             return _Layer(init, run, lambda s: s[0], None,
-                          lambda s: s[1], replicated_restore)
+                          lambda s: s[1], replicated_restore,
+                          step_fn=loop)
 
         step = make_train_step(cfg, hp, lr_schedule=schedule, donate=donate)
 
@@ -163,7 +169,7 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             return (params, opt), loss
 
         return _Layer(init, run, lambda s: s[0], None,
-                      lambda s: s[1], replicated_restore)
+                      lambda s: s[1], replicated_restore, step_fn=step)
 
     from cs336_systems_tpu.parallel.mesh import make_mesh
 
@@ -192,7 +198,7 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             return (params, opt), loss
 
         return _Layer(init, run, lambda s: s[0], mesh,
-                      lambda s: s[1], replicated_restore)
+                      lambda s: s[1], replicated_restore, step_fn=step)
     if parallel == "zero1":
         from cs336_systems_tpu.models.transformer import init_transformer_lm
         from cs336_systems_tpu.parallel.zero import (
@@ -222,7 +228,7 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             return (params, zero1_restore(_require_opt(ck), params, mesh))
 
         return _Layer(init, run, lambda s: s[0], mesh,
-                      lambda s: s[1], restore)
+                      lambda s: s[1], restore, step_fn=step)
     if parallel == "fsdp":
         from cs336_systems_tpu.models.transformer import init_transformer_lm
         from cs336_systems_tpu.parallel.fsdp import (
@@ -251,6 +257,7 @@ def _build(cfg: TransformerConfig, hp: AdamWHparams, schedule, parallel: str,
             init, run, lambda s: fsdp_gather_params(s, params_like), mesh,
             lambda s: s,  # the whole state (fp32 master chunks + m/v + t)
             lambda ck: fsdp_restore(_require_opt(ck), params_like, mesh),
+            step_fn=step,
         )
     if parallel in ("tp", "sp", "pp", "ep", "tp_sp"):
         return _build_mesh_mode(
@@ -377,7 +384,7 @@ def _build_mesh_mode(cfg, hp, schedule, parallel, donate, mesh_axes,
         return place(ck["params"], _require_opt(ck))
 
     return _Layer(init, run, lambda s: s[0], mesh, lambda s: s[1], restore,
-                  batch_spec=batch_spec)
+                  batch_spec=batch_spec, step_fn=step)
 
 
 def main(argv=None) -> None:
@@ -652,6 +659,39 @@ def main(argv=None) -> None:
 
         tele = open(args.telemetry, "a")
 
+        # one-time static memory account of the exact per-step callable
+        # this run dispatches (memkit liveness over the optimized HLO) —
+        # additive telemetry, never fatal: a mode memkit can't analyze
+        # just writes null
+        analyzed_peak = None
+        try:
+            from cs336_systems_tpu.analysis import memkit
+
+            _fn = run_metrics if run_metrics is not None else run
+            state_abs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
+                state,
+            )
+            batch_abs = jax.ShapeDtypeStruct((args.batch, args.ctx), "int32")
+            analyzed_peak = memkit.profile_callable(
+                _fn, (state_abs, batch_abs, batch_abs),
+                family=f"train_cli_{args.parallel}",
+            )["peak_bytes"]
+        except Exception:  # noqa: BLE001 — telemetry is additive
+            pass
+
+        # the jitted callable whose compile cache tells recompile truth
+        _tracked = _mstep if run_metrics is not None else layer.step_fn
+
+        def _recompile_count():
+            # cache size 1 = the expected first compile; anything above is
+            # a silent shape/dtype-driven recompile mid-run
+            try:
+                return max(0, _tracked._cache_size() - 1)
+            except Exception:  # noqa: BLE001 — internal API, may move
+                return None
+
     t0 = time.perf_counter()
     tokens_done = 0
     step_i = step_saved = start_step
@@ -691,6 +731,8 @@ def main(argv=None) -> None:
                               if gnorm is not None else None),
                 "tokens_per_s": round(tokens_done / wall, 1),
                 "live_buffer_bytes": live_buffer_bytes(),
+                "analyzed_peak_hbm_bytes": analyzed_peak,
+                "recompile_count": _recompile_count(),
                 "wall_s": round(wall, 3),
             }) + "\n")
             tele.flush()

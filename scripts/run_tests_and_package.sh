@@ -35,6 +35,22 @@ python -m cs336_systems_tpu.analysis.trace_cli --step train_single \
 trace_status=$?
 [ "$status" -eq 0 ] && status=$trace_status
 
+# memkit gate: one analyzed memprofile end to end (lower -> scheduled-HLO
+# liveness walk -> phase x class composition -> memory_analysis cross-
+# check), then the self-diff must flag nothing (exit 0) — together they
+# catch HLO-format drift that would silently break the memory accounting.
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.mem_cli --step train_single \
+    --out /tmp/mem_smoke.memprofile.json
+mem_status=$?
+if [ "$mem_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.mem_cli \
+        --diff /tmp/mem_smoke.memprofile.json /tmp/mem_smoke.memprofile.json
+    mem_status=$?
+fi
+[ "$status" -eq 0 ] && status=$mem_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -321,3 +321,34 @@ def test_mosaic_crash_matrix_enforced():
     may never choose it."""
     assert fa.fwd_group_cap(4, 16) == 2
     assert fa._pick_group(8, 128, 128, 16, 4) <= 2
+
+
+# --- HBM budget rule (memkit-backed) ----------------------------------------
+
+
+def test_hbm_budget_declared_families_are_registered():
+    """Every budgeted family must be a real registry step — a typo'd key
+    would silently never be checked by lint_step."""
+    from cs336_systems_tpu.analysis import memkit
+
+    assert set(registry.HBM_BUDGET_BYTES) <= set(memkit.family_names())
+    assert all(b > 0 for b in registry.HBM_BUDGET_BYTES.values())
+
+
+def test_hbm_budget_rule_clean_then_mutated():
+    """Same mutation discipline as the other rules: the shipped budget
+    passes on the current tree, and an (artificially) starved budget for
+    the SAME family fires with the peak/ratio diagnostic."""
+    assert contracts.check_hbm_budget("train_single",
+                                      registry.HBM_BUDGET_BYTES["train_single"]) == []
+    vs = contracts.check_hbm_budget("train_single", 1 << 20)
+    assert _rules(vs) == {"hbm-budget"}
+    assert "exceeds" in vs[0].message and "peak" in vs[0].message
+
+
+def test_hbm_budget_rule_survives_analysis_failure():
+    """A family memkit can't lower must surface as a violation, not an
+    exception that kills the whole lint run."""
+    vs = contracts.check_hbm_budget("not_a_registered_family", 1 << 30)
+    assert _rules(vs) == {"hbm-budget"}
+    assert "failed to analyze" in vs[0].message

@@ -1,0 +1,432 @@
+"""memkit tests: buffer-liveness reconstruction against synthetic HLO
+fixtures with HAND-COMPUTED peaks, the aliasing rules that carry the
+model's accuracy (tuple-element-precise while carries, in-place
+dynamic-update-slice fusions, input_output_alias donation), buffer
+classification, the diff gate, OOM forensics, and CPU end-to-end smokes
+of ``mem_cli`` (exit codes included).
+
+Same oracle discipline as test_tracekit.py: every modeling rule is
+pinned by a fixture whose correct answer is computed by hand in a
+comment, then the full pipeline runs end to end on the hermetic CPU mesh
+and must land within the acceptance band of XLA's own
+``memory_analysis()`` totals.
+"""
+
+import json
+
+import pytest
+
+from cs336_systems_tpu.analysis import memkit
+from cs336_systems_tpu.analysis.memkit import (
+    BufferInfo,
+    analyze_hlo,
+    check_budget,
+    classify_buffer,
+    diff_memprofiles,
+    explain_oom,
+    parse_io_aliases,
+    parse_oom_demand,
+    profile_hlo,
+    shape_bytes,
+)
+
+
+# --- shape parsing ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("type_str,expected", [
+    ("f32[256]{0}", 1024),
+    ("bf16[8,128]{1,0}", 2048),
+    ("s32[]", 4),
+    ("pred[]", 1),
+    ("(f32[1024]{0}, f32[16]{0}, s32[])", 4096 + 64 + 4),
+    ("token[]", 0),  # unknown leaf types count zero, not crash
+])
+def test_shape_bytes(type_str, expected):
+    assert shape_bytes(type_str) == expected
+
+
+# --- fixture A: linear chain ------------------------------------------------
+# Hand-computed walk (1 KiB per f32[256] buffer):
+#   up-front: params p0+p1 = 2048, root output sub.3 reserved = 1024
+#   add.1 (1 KiB, dies before the output is defined) PARKS in the output
+#   slot — XLA places short-lived temps inside not-yet-defined output
+#   allocations — so the peak is NOT 3072+1024 at add.1;
+#   mul.2 (1 KiB) cannot park (slot busy until add.1's last use) -> +1024
+#   peak = 2048 + 1024 + 1024 = 4096, at mul.2
+
+_HLO_CHAIN = """\
+HloModule jit_f, is_scheduled=true, entry_computation_layout={(f32[256]{0}, f32[256]{0})->f32[256]{0}}
+
+ENTRY %main (p0: f32[256], p1: f32[256]) -> f32[256] {
+  %p0 = f32[256]{0} parameter(0)
+  %p1 = f32[256]{0} parameter(1)
+  %add.1 = f32[256]{0} add(%p0, %p1), metadata={op_name="jit(f)/fwd/ffn/up_proj"}
+  %mul.2 = f32[256]{0} multiply(%add.1, %add.1), metadata={op_name="jit(f)/fwd/ffn/gate"}
+  ROOT %sub.3 = f32[256]{0} subtract(%mul.2, %p1), metadata={op_name="jit(f)/transpose(jvp(f))/ffn/down"}
+}
+"""
+
+
+def test_chain_peak_with_output_slot_parking():
+    a = analyze_hlo(_HLO_CHAIN)
+    assert a.peak_bytes == 4096
+    assert a.peak_at[0] == "mul.2"
+
+
+def test_chain_phase_highwater():
+    a = analyze_hlo(_HLO_CHAIN)
+    assert a.phase_peak_bytes["fwd-ffn"] == 4096
+    assert a.phase_peak_bytes["bwd"] == 4096  # transpose( scope at sub.3
+    # before any temp exists only params+reserved outputs are live
+    assert a.phase_peak_bytes["other"] == 3072
+
+
+def test_chain_profile_composition_and_classes():
+    p = profile_hlo(_HLO_CHAIN, family="chain",
+                    arg_classes=["params", "optimizer-state"])
+    assert p["schema"] == "memprofile/v1"
+    assert p["peak_bytes"] == 4096
+    # at the peak: p0 (params), p1 (optimizer-state via param index),
+    # the reserved output, and mul.2 — defined fwd-ffn, freed by the
+    # backward consumer => an activation stash
+    assert p["composition_bytes"] == {
+        "params": 1024, "optimizer-state": 1024,
+        "output": 1024, "activation-stash": 1024,
+    }
+    assert p["peak_at"]["phase"] == "fwd-ffn"
+
+
+# --- fixture B: while carry, tuple-element precision ------------------------
+# carry = (f32[1024] from %dbl, f32[16] from %p1, s32[]); after the while
+# only element 1 is read (%gte.small). Element-precise aliasing frees
+# %dbl's 4096 B at the while, so the f32[4096] temp %big (16384 B) peaks
+# WITHOUT %dbl live:
+#   up-front: params 4096+64, const 4, output reserve 64 -> 4228
+#   at %big: 4228 + 16384 = 20612  <- the peak
+#   at %while: 4228 + 4096 (dbl) + body transient 72 = 8396
+# A whole-carry alias union (the bug this pins) would keep %dbl live
+# through %out and report 24708.
+
+_HLO_WHILE = """\
+HloModule jit_g, is_scheduled=true, entry_computation_layout={(f32[1024]{0}, f32[16]{0})->f32[16]{0}}
+
+%cond (c: (f32[1024], f32[16], s32[])) -> pred[] {
+  %c = (f32[1024]{0}, f32[16]{0}, s32[]) parameter(0)
+  %gte.c = s32[] get-tuple-element(%c), index=2
+  %k = s32[] constant(3)
+  ROOT %lt = pred[] compare(%gte.c, %k), direction=LT
+}
+
+%body (b: (f32[1024], f32[16], s32[])) -> (f32[1024], f32[16], s32[]) {
+  %b = (f32[1024]{0}, f32[16]{0}, s32[]) parameter(0)
+  %gte.0 = f32[1024]{0} get-tuple-element(%b), index=0
+  %gte.1 = f32[16]{0} get-tuple-element(%b), index=1
+  %gte.2 = s32[] get-tuple-element(%b), index=2
+  %neg.b = f32[16]{0} negate(%gte.1)
+  %one = s32[] constant(1)
+  %inc = s32[] add(%gte.2, %one)
+  ROOT %tup = (f32[1024]{0}, f32[16]{0}, s32[]) tuple(%gte.0, %neg.b, %inc)
+}
+
+ENTRY %main (p0: f32[1024], p1: f32[16]) -> f32[16] {
+  %p0 = f32[1024]{0} parameter(0)
+  %p1 = f32[16]{0} parameter(1)
+  %zero = s32[] constant(0)
+  %dbl = f32[1024]{0} multiply(%p0, %p0)
+  %init = (f32[1024]{0}, f32[16]{0}, s32[]) tuple(%dbl, %p1, %zero)
+  %w = (f32[1024]{0}, f32[16]{0}, s32[]) while(%init), condition=%cond, body=%body
+  %gte.small = f32[16]{0} get-tuple-element(%w), index=1
+  %big = f32[4096]{0} exponential(%p0)
+  ROOT %out = f32[16]{0} add(%gte.small, %p1)
+}
+"""
+
+
+def test_while_carry_element_precise_liveness():
+    a = analyze_hlo(_HLO_WHILE)
+    assert a.peak_bytes == 20612
+    assert a.peak_at[0] == "big"
+
+
+# --- fixture C: fusion with dynamic-update-slice root is in-place -----------
+# The lowering of every scan stash / KV-cache write. %upd must alias
+# %buf's buffer (the DUS target), not allocate 4 KiB of its own:
+#   params 4096+64, const 4, output reserve (%done) 4096, %buf 4096
+#   peak = 12356; a fresh allocation for the fusion would say 16452.
+
+_HLO_DUS = """\
+HloModule jit_h, is_scheduled=true, entry_computation_layout={(f32[64,16]{1,0}, f32[1,16]{1,0})->f32[64,16]{1,0}}
+
+%fused_dus (fp0: f32[64,16], fp1: f32[1,16], fp2: s32[], fp3: s32[]) -> f32[64,16] {
+  %fp0 = f32[64,16]{1,0} parameter(0)
+  %fp1 = f32[1,16]{1,0} parameter(1)
+  %fp2 = s32[] parameter(2)
+  %fp3 = s32[] parameter(3)
+  ROOT %dus.f = f32[64,16]{1,0} dynamic-update-slice(%fp0, %fp1, %fp2, %fp3)
+}
+
+ENTRY %main (p0: f32[64,16], p1: f32[1,16]) -> f32[64,16] {
+  %p0 = f32[64,16]{1,0} parameter(0)
+  %p1 = f32[1,16]{1,0} parameter(1)
+  %i = s32[] constant(0)
+  %buf = f32[64,16]{1,0} copy(%p0), metadata={op_name="jit(h)/fwd/attn/kv_update/stash"}
+  %upd = f32[64,16]{1,0} fusion(%buf, %p1, %i, %i), kind=kLoop, calls=%fused_dus
+  ROOT %done = f32[64,16]{1,0} copy(%upd)
+}
+"""
+
+
+def test_dus_fusion_updates_in_place():
+    a = analyze_hlo(_HLO_DUS)
+    assert a.peak_bytes == 12356
+
+
+# --- fixture D: donation (input_output_alias) -------------------------------
+# Outputs {0} and {1} write into the donated parameter buffers; only
+# output {2} gets its own allocation: peak = 3*1024 params + 1024 = 4096
+# (an alias-blind walk reserves all three outputs and says 6144).
+
+_HLO_DONATED = """\
+HloModule jit_d, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, entry_computation_layout={(f32[256]{0}, f32[256]{0}, f32[256]{0})->(f32[256]{0}, f32[256]{0}, f32[256]{0})}
+
+ENTRY %main (p0: f32[256], p1: f32[256], p2: f32[256]) -> (f32[256], f32[256], f32[256]) {
+  %p0 = f32[256]{0} parameter(0)
+  %p1 = f32[256]{0} parameter(1)
+  %p2 = f32[256]{0} parameter(2)
+  %new0 = f32[256]{0} add(%p0, %p2)
+  %new1 = f32[256]{0} multiply(%p1, %p2)
+  %new2 = f32[256]{0} subtract(%p2, %p0)
+  ROOT %tup = (f32[256]{0}, f32[256]{0}, f32[256]{0}) tuple(%new0, %new1, %new2)
+}
+"""
+
+
+def test_io_alias_parse_handles_nested_braces():
+    # the map nests {} inside {} — a naive regex sees only the first pair
+    assert parse_io_aliases(_HLO_DONATED) == {0: 0, 1: 1}
+    assert parse_io_aliases(_HLO_CHAIN) == {}
+
+
+def test_donated_outputs_reuse_param_buffers():
+    assert analyze_hlo(_HLO_DONATED).peak_bytes == 4096
+
+
+# --- classification ---------------------------------------------------------
+
+
+def _buf(**kw):
+    d = dict(name="x", bytes=64, opcode="fusion", scope="",
+             def_phase="other", free_phase="other", param_idx=None)
+    d.update(kw)
+    return BufferInfo(**d)
+
+
+@pytest.mark.parametrize("info,classes,expected", [
+    (_buf(opcode="parameter", param_idx=0), ["params", "batch"], "params"),
+    (_buf(opcode="parameter", param_idx=1), ["params", "batch"], "batch"),
+    (_buf(opcode="parameter", param_idx=9), ["params"], "params"),
+    (_buf(opcode="constant"), [], "constant"),
+    (_buf(opcode="all-reduce"), [], "collective"),
+    (_buf(opcode="all-gather-start"), [], "collective"),
+    (_buf(scope="jit(g)/decode/kv_update/dus"), [], "kv-cache"),
+    (_buf(def_phase="fwd-ffn", free_phase="bwd"), [], "activation-stash"),
+    (_buf(def_phase="fwd-ffn", free_phase="bwd",
+          scope="jit(s)/ffn/gmm_w13/pallas_call"), [], "gmm-residual"),
+    (_buf(def_phase="fwd-attn", free_phase="fwd-attn"), [], "temp"),
+    (_buf(def_phase="bwd", free_phase="bwd"), [], "temp"),
+])
+def test_classify_buffer(info, classes, expected):
+    assert classify_buffer(info, classes) == expected
+
+
+# --- diff gate --------------------------------------------------------------
+
+
+def _profile(peak=10 << 20, fam="train_single", **over):
+    p = {
+        "schema": memkit.SCHEMA, "family": fam, "peak_bytes": peak,
+        "phase_peak_bytes": {"fwd-attn": peak, "bwd": peak // 2},
+        "composition_bytes": {"params": peak // 4, "temp": peak // 2},
+    }
+    p.update(over)
+    return p
+
+
+def test_diff_identical_flags_nothing():
+    d = diff_memprofiles(_profile(), _profile())
+    assert d["n_flagged"] == 0
+
+
+def test_diff_flags_real_regression():
+    b = _profile(peak=20 << 20)
+    d = diff_memprofiles(_profile(), b)
+    flagged = [r for r in d["rows"] if r["flagged"]]
+    assert any(r["key"] == "peak_bytes" for r in flagged)
+
+
+def test_diff_dual_gate_absolute_floor():
+    # +50% on a small phase: over the pct gate, under the 1 MiB floor
+    a = _profile()
+    b = _profile()
+    b["phase_peak_bytes"] = dict(a["phase_peak_bytes"], routing=512 << 10)
+    a["phase_peak_bytes"] = dict(a["phase_peak_bytes"], routing=256 << 10)
+    assert diff_memprofiles(a, b)["n_flagged"] == 0
+    # same relative jump above the floor IS flagged
+    b["phase_peak_bytes"]["routing"] = 8 << 20
+    a["phase_peak_bytes"]["routing"] = 4 << 20
+    assert diff_memprofiles(a, b)["n_flagged"] == 1
+
+
+def test_diff_family_mismatch_raises():
+    with pytest.raises(ValueError, match="different families"):
+        diff_memprofiles(_profile(), _profile(fam="serve_dp"))
+
+
+# --- budgets ----------------------------------------------------------------
+
+
+def test_check_budget():
+    assert check_budget(_profile(peak=10 << 20), 48 << 20) == []
+    assert len(check_budget(_profile(peak=10 << 20), 1 << 20)) == 1
+
+
+def test_registry_budgets_name_real_families():
+    from cs336_systems_tpu.analysis import registry
+
+    assert set(registry.HBM_BUDGET_BYTES) <= set(memkit.family_names())
+
+
+# --- OOM forensics ----------------------------------------------------------
+
+
+def test_parse_oom_demand_total_usage_shape():
+    msg = ("RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm. "
+           "Total hbm usage >= 17.48G:\n  reserved 1.00G\n"
+           "program 16.48G\nlimit: 15.70G")
+    peak, limit = parse_oom_demand(msg)
+    assert peak == int(17.48 * 2**30)
+    assert limit == int(15.70 * 2**30)
+
+
+def test_parse_oom_demand_used_of_shape():
+    peak, limit = parse_oom_demand("Used 14.2G of 15.7G hbm")
+    assert peak == int(14.2 * 2**30)
+    assert limit == int(15.7 * 2**30)
+
+
+def test_parse_oom_demand_not_an_oom():
+    assert parse_oom_demand("Segmentation fault") == (None, None)
+
+
+def test_parse_oom_demand_reexported_for_benchmarks():
+    # benchmarks/memory moved its parser here; the old private name must
+    # keep resolving for pre-memkit callers
+    from cs336_systems_tpu.benchmarks.memory import _parse_oom_demand
+
+    assert _parse_oom_demand is parse_oom_demand
+
+
+def test_explain_oom_joins_profile():
+    e = explain_oom("Total hbm usage >= 2.0G\nlimit: 1.0G",
+                    _profile(peak=1 << 30))
+    assert e["over_limit_bytes"] == 1 << 30
+    assert e["demand_over_analyzed"] == 2.0
+    assert "2.0x" in memkit.format_explain(e)
+
+
+# --- CPU end-to-end ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def train_single_profile():
+    return memkit.profile_family("train_single")
+
+
+def test_profile_family_matches_xla_crosscheck(train_single_profile):
+    p = train_single_profile
+    assert p["schema"] == "memprofile/v1"
+    assert p["family"] == "train_single"
+    total = p["xla"]["total_bytes"]
+    assert total > 0
+    # the acceptance band: analyzed peak within 10% of the XLA totals
+    assert 0.9 <= p["peak_bytes"] / total <= 1.1
+    # params must be classified: the at-peak live set carries the model
+    assert p["composition_bytes"].get("params", 0) > 0
+    assert p["composition_bytes"].get("optimizer-state", 0) > 0
+    assert sum(p["composition_bytes"].values()) == p["peak_bytes"]
+
+
+def test_profile_family_serve_smoke():
+    p = memkit.profile_family("serve_dp")
+    total = p["xla"]["total_bytes"]
+    assert 0.9 <= p["peak_bytes"] / total <= 1.1
+    assert p["n_devices"] == 8
+
+
+def test_format_profile_renders(train_single_profile):
+    text = memkit.format_profile(train_single_profile)
+    assert "analyzed peak" in text and "composition at peak" in text
+
+
+def test_unknown_family_raises():
+    with pytest.raises(KeyError, match="unknown step family"):
+        memkit.profile_family("not_a_family")
+
+
+# --- mem_cli ----------------------------------------------------------------
+
+
+def test_mem_cli_list_exits_zero(capsys):
+    from cs336_systems_tpu.analysis import mem_cli
+
+    assert mem_cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "train_single" in out and "bench_headline" in out
+
+
+def test_mem_cli_step_json_and_diff_roundtrip(tmp_path, capsys,
+                                              train_single_profile):
+    from cs336_systems_tpu.analysis import mem_cli
+
+    a = tmp_path / "a.json"
+    memkit.write_profile(train_single_profile, str(a))
+
+    # self-compare exits 0 (the dual gate flags nothing on identity)
+    assert mem_cli.main(["--diff", str(a), str(a)]) == 0
+    capsys.readouterr()
+
+    # injected regression >= threshold exits 1
+    worse = json.loads(a.read_text())
+    worse["peak_bytes"] = int(worse["peak_bytes"] * 1.5) + (4 << 20)
+    worse["phase_peak_bytes"] = {
+        k: int(v * 1.5) + (4 << 20)
+        for k, v in worse["phase_peak_bytes"].items()
+    }
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps(worse))
+    assert mem_cli.main(["--diff", str(a), str(b)]) == 1
+    assert "FLAGGED" in capsys.readouterr().out
+
+
+def test_mem_cli_step_writes_profile(tmp_path, capsys):
+    from cs336_systems_tpu.analysis import mem_cli
+
+    out = tmp_path / "serve.memprofile.json"
+    assert mem_cli.main(["--step", "serve_tp", "--json",
+                         "--out", str(out)]) == 0
+    printed = json.loads(capsys.readouterr().out)
+    on_disk = json.loads(out.read_text())
+    assert printed["schema"] == on_disk["schema"] == "memprofile/v1"
+    assert printed["family"] == "serve_tp"
+
+
+def test_mem_cli_explain_oom(tmp_path, capsys):
+    from cs336_systems_tpu.analysis import mem_cli
+
+    log = tmp_path / "oom.log"
+    log.write_text("RESOURCE_EXHAUSTED: Ran out of memory in memory "
+                   "space hbm. Total hbm usage >= 17.48G\nlimit: 15.70G")
+    assert mem_cli.main(["--explain-oom", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "17.48GiB" in out and "15.70GiB" in out
