@@ -41,7 +41,8 @@ def model_flops_per_token(cfg, causal: bool = True) -> float:
     return 6 * n_matmul + attn
 
 
-def decode_flops_per_token(cfg, attend_len: int | None = None) -> float:
+def decode_flops_per_token(cfg, attend_len: int | None = None,
+                           attend_lens=None) -> float:
     """Analytic matmul FLOPs per GENERATED token in cached decoding.
 
     Forward only (2·N_matmul for the parameter matmuls) plus the cached
@@ -52,9 +53,24 @@ def decode_flops_per_token(cfg, attend_len: int | None = None) -> float:
     ``models/decode._generate_scan`` approaches; callers with a known fill
     level pass it for a tighter number. Prefill FLOPs are NOT amortized in
     (they are a one-time cost, reported separately by the decode bench).
+
+    ``attend_lens``: per-row attended lengths for RAGGED batches. A step
+    generates B tokens while row i attends len_i rows, so the batch's
+    attention work is 4·sum(lens)·d·L per step and the PER-TOKEN share is
+    the MEAN of the lens — not the batch max (which overstated skewed-
+    batch MFU before the paged path made the kernel cost track per-row
+    length too). Mutually exclusive with ``attend_len``.
     """
     d, dff, L = cfg.d_model, cfg.d_ff, cfg.num_layers
-    attend = attend_len if attend_len is not None else cfg.context_length
+    if attend_lens is not None:
+        if attend_len is not None:
+            raise ValueError(
+                "pass attend_len or attend_lens, not both (ragged batches "
+                "sum per-row lengths; a scalar bound would double-specify)")
+        lens = [int(x) for x in attend_lens]
+        attend = sum(lens) / len(lens)
+    else:
+        attend = attend_len if attend_len is not None else cfg.context_length
     e = getattr(cfg, "num_experts", 0)
     ffn_mult = max(getattr(cfg, "moe_top_k", 1), 1) if e else 1
     n_matmul = (

@@ -8,7 +8,7 @@ finding hinged on memory (training b48 OOMs under gmm because of the h/g
 residuals, ctx-65536 needs ``--remat`` or it stashes 25 GB, the fused
 flash backward lives or dies on a 16M/18.3M VMEM boundary; BASELINE.md).
 
-What it does, per registered step family (the same 13 train/serve
+What it does, per registered step family (the same 14 train/serve
 families tracekit drives, plus the headline/decode/MoE bench shapes):
 
 - lowers the step over its (tiny or abstract) inputs and compiles it,
@@ -568,7 +568,7 @@ def xla_memory_stats(compiled) -> dict:
 # ---------------------------------------------------------------------------
 # Step families
 #
-# The 13 registered train/serve families reuse tracekit's runnable
+# The 14 registered train/serve families reuse tracekit's runnable
 # bundles (same factories as train_cli/parallel.serve, donate=False so
 # the bundle is reusable). ARG_CLASSES labels each family's top-level
 # arguments; flattened leaf order matches entry parameter numbering.
@@ -599,6 +599,7 @@ ARG_CLASSES: dict[str, tuple] = {
     "serve_tp": _serve_arg_classes(),
     "serve_ep": _serve_arg_classes(),
     "serve_tp_ragged": _serve_arg_classes(),
+    "serve_ragged_paged": _serve_arg_classes(),
 }
 
 

@@ -273,7 +273,7 @@ def _build_gmm13_bwd() -> Traced:
 
 
 def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
-                 ragged=False) -> Traced:
+                 ragged=False, paged=False) -> Traced:
     from cs336_systems_tpu.parallel.mesh import make_mesh
     from cs336_systems_tpu.parallel.serve import (
         lint_contract, make_sharded_generate)
@@ -285,12 +285,14 @@ def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
     key = jax.ShapeDtypeStruct((2,), jnp.uint32)
     gen = make_sharded_generate(
         cfg, make_mesh(mesh_axes), max_new_tokens=4, dp_axis=dp_axis,
-        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8)
+        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8,
+        page_block=SERVE_PAGED_BLOCK if paged else None)
     if ragged:
         # per-row prompt lengths are host-side ints (they pick the shard_map
-        # program and the cache allocation), so close over concrete values
-        lens = np.full((8,), 6, np.int32)
-        lens[:4] = 3
+        # program and the cache allocation), so close over concrete values;
+        # the paged family uses the SKEWED profile (one long row) so the
+        # pool-vs-B·max margin the memkit test asserts is visible here
+        lens = serve_ragged_lens(paged)
         fn = lambda p, i, k: gen(p, i, k, prompt_lens=lens)
     else:
         fn = gen
@@ -299,6 +301,30 @@ def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
                                   ep_axis=ep_axis),
                     phase_scopes=SERVE_PHASE_SCOPES)
     return Traced(jaxpr, None, contract)
+
+
+# Page size for the serve_ragged_paged registry family: tiny like the
+# registry shapes (8 rows/page) so the skewed batch's pool is genuinely
+# smaller than B·max at a 6-token prompt — the production default is
+# models/decode.PAGE_BLOCK.
+SERVE_PAGED_BLOCK = 8
+
+
+def serve_ragged_lens(paged: bool):
+    """Concrete per-row prompt lengths for the ragged serve families —
+    shared with tracekit/memkit and the tests so the registry shape and
+    the assertions about it cannot drift. The paged profile is SKEWED
+    (one max-length row, the rest short): with max_new=4 and 8-row pages,
+    row 0 needs 2 pages and every other row 1 — SPMD sizes every dp
+    shard's pool at the max local count, so each 1-row shard holds
+    2 pages + the write-scratch page (24 rows) vs the unpaged path's
+    64-row bucket-rounded cache alloc."""
+    lens = np.full((8,), 6, np.int32)
+    if paged:
+        lens[1:] = 2
+    else:
+        lens[:4] = 3
+    return lens
 
 
 STEPS: tuple[StepSpec, ...] = (
@@ -323,6 +349,9 @@ STEPS: tuple[StepSpec, ...] = (
     StepSpec("serve_tp_ragged",
              functools.partial(_build_serve, {"dp": 2, "tp": 4}, "dp",
                                "tp", None, True)),
+    StepSpec("serve_ragged_paged",
+             functools.partial(_build_serve, {"dp": 8}, "dp",
+                               None, None, True, True)),
 )
 
 
@@ -339,4 +368,7 @@ HBM_BUDGET_BYTES: dict[str, int] = {
     "train_single": 48 << 20,   # analyzed peak ~11.4 MB
     "train_tp": 8 << 20,        # analyzed peak ~1.5 MB
     "serve_dp": 2 << 20,        # analyzed peak ~0.23 MB
+    "serve_ragged_paged": 1 << 20,  # analyzed peak ~0.20 MB — the paged
+    # pool keeps the skewed family's peak BELOW serve_dp's budget even
+    # with the page tables and prefill page gather in the program
 }

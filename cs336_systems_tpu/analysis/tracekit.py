@@ -489,11 +489,11 @@ def _build_train_ep_a2a() -> Runner:
 
 
 def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
-                 ragged=False) -> Runner:
+                 ragged=False, paged=False) -> Runner:
     import jax
-    import numpy as np
 
-    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.analysis.registry import (
+        SERVE_PAGED_BLOCK, _tiny_cfg, serve_ragged_lens)
     from cs336_systems_tpu.models.transformer import init_transformer_lm
     from cs336_systems_tpu.parallel.mesh import make_mesh
     from cs336_systems_tpu.parallel.serve import make_sharded_generate
@@ -504,20 +504,25 @@ def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
     max_new = 4
     gen = make_sharded_generate(
         cfg, mesh, max_new_tokens=max_new, dp_axis=dp_axis,
-        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8)
+        tp_axis=tp_axis, ep_axis=ep_axis, temperature=0.9, top_k=8,
+        page_block=SERVE_PAGED_BLOCK if paged else None)
     params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
     b, p = 8, 6
     ids = jax.random.randint(jax.random.PRNGKey(1), (b, p), 0,
                              cfg.vocab_size)
     key = jax.random.PRNGKey(2)
     if ragged:
-        lens = np.full((b,), p, np.int32)
-        lens[: b // 2] = 3
+        # same concrete lens as the registry's abstract trace; ragged MFU
+        # uses the per-row MEAN attended length (sum(lens)/B), not the
+        # batch max — the skewed paged family would otherwise overstate
+        # its FLOPs ~2x (analysis/flops.decode_flops_per_token)
+        lens = serve_ragged_lens(paged)
         fn = lambda pr, i, k: gen(pr, i, k, prompt_lens=lens)
+        flops = decode_flops_per_token(cfg, attend_lens=lens + max_new)
     else:
         fn = gen
-    return Runner(fn, (params, ids, key), b * max_new,
-                  decode_flops_per_token(cfg), mesh.size)
+        flops = decode_flops_per_token(cfg)
+    return Runner(fn, (params, ids, key), b * max_new, flops, mesh.size)
 
 
 FAMILIES: dict[str, Callable[[], Runner]] = {
@@ -535,6 +540,8 @@ FAMILIES: dict[str, Callable[[], Runner]] = {
     "serve_ep": lambda: _build_serve({"dp": 2, "ep": 4}, "dp", None, "ep"),
     "serve_tp_ragged": lambda: _build_serve({"dp": 2, "tp": 4}, "dp", "tp",
                                             None, True),
+    "serve_ragged_paged": lambda: _build_serve({"dp": 8}, "dp", None, None,
+                                               True, True),
 }
 
 

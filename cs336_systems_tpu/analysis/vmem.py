@@ -227,6 +227,28 @@ CHECKS: tuple[VmemCheck, ...] = (
         "the serving dispatcher's supported() gate must accept the "
         "benchmark's real configs (d=128 packed to 256 lanes)",
     ),
+    # --- paged decode serving ---------------------------------------------
+    VmemCheck(
+        "decode-paged-slab-budget",
+        lambda: all(
+            da.paged_decode_vmem_bytes(
+                da._pick_group_paged(8, blk, 256, 2, 128, 8), blk, 256, 2)
+            <= da.DECODE_SLAB_BUDGET
+            for blk in (64, 128, 256)
+        ),
+        "the paged kernel's per-step live set is ONE K‖V page block (not "
+        "the whole window) — _pick_group_paged's choice stays under the "
+        "8 MB slab budget at every shipped page-block size",
+    ),
+    VmemCheck(
+        "decode-paged-supported-agrees",
+        lambda: (da.paged_supported(128, 128, 2)
+                 and da.paged_supported(128, 128, 4)
+                 and not da.paged_supported(12, 128, 2)),
+        "paged_supported() must accept the PAGE_BLOCK=128 default (bf16 "
+        "and fp32) and reject non-8-row-aligned blocks — HBM write-back "
+        "tiles are 8-row-aligned, a 12-row page cannot be merge-tiled",
+    ),
 )
 
 
@@ -269,5 +291,7 @@ def estimate_report() -> list[tuple[str, float]]:
          gm.gmm_fused_dw_vmem_bytes(256, 512, 768, 2)),
         ("decode slab g8 S=1024 w256 bf16",
          da.decode_vmem_bytes(8, 1024, 256, 2)),
+        ("decode paged g8 block=128 w256 bf16",
+         da.paged_decode_vmem_bytes(8, 128, 256, 2)),
     ]
     return [(name, b / _MB) for name, b in rows]

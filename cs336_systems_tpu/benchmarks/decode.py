@@ -50,7 +50,8 @@ _HBM_GBPS = 819.0
 _DISPATCH_FLOOR_MS = 230.0
 
 
-def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> float:
+def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int,
+                        lens=None, page_block: int | None = None) -> float:
     """Analytic HBM-bound total milliseconds for the cached decode steps.
 
     At serving time each decode step must read (a) every matmul weight once
@@ -58,6 +59,13 @@ def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> fl
     scan) and (b) the filled K/V cache prefix for every layer; writes and
     activations are negligible. The attended prefix follows the
     bucket-rounded fill schedule of models/decode._generate_scan.
+
+    ``lens``+``page_block``: PAGED rows read only each row's own touched
+    pages — sum((len_i + i)//block + 1)·block cache rows per step instead
+    of batch·(bucketed max). That per-row sum IS the paged path's headline
+    claim: skewed batches pay mean length, not max. ``lens`` without
+    ``page_block`` models the unpaged ragged row, whose kernel still
+    streams the batch-global bucketed prefix for every row.
     """
     from cs336_systems_tpu.models.decode import _ATTEND_BUCKET, _round_up
 
@@ -80,8 +88,15 @@ def _decode_roofline_ms(cfg, batch: int, prompt_len: int, new_tokens: int) -> fl
     h, dh = cfg.num_heads, cfg.d_head
     total = 0.0
     for i in range(new_tokens):
-        attend = min(_round_up(prompt_len + i + 1, _ATTEND_BUCKET), alloc)
-        cache_bytes = 2 * batch * h * attend * dh * 2 * L  # K+V, bf16
+        if lens is not None and page_block is not None:
+            # paged: row i's kernel grid early-outs past its own last
+            # touched page, so the cache read is the per-row page sum
+            rows_read = sum(((int(l) + i) // page_block + 1) * page_block
+                            for l in lens)
+        else:
+            attend = min(_round_up(prompt_len + i + 1, _ATTEND_BUCKET), alloc)
+            rows_read = batch * attend
+        cache_bytes = 2 * h * rows_read * dh * 2 * L  # K+V, bf16
         total += (weight_bytes + cache_bytes) / (_HBM_GBPS * 1e9)
     return total * 1e3
 
@@ -96,6 +111,8 @@ def benchmark_decode(
     experts: int = 0,
     moe_top_k: int = 2,
     ragged: bool = False,
+    skew: tuple[str, ...] = (),
+    page_block: int = 128,
     out_path: str | None = None,
 ) -> list[dict]:
     from cs336_systems_tpu.models.decode import (
@@ -177,8 +194,10 @@ def benchmark_decode(
     for b in batch_sizes:
         prompts = jnp.tile(jnp.asarray([prompt], jnp.int32), (b, 1))
 
-        def batched_row(path: str, dt_b: float, b=b):
-            roof_ms = _decode_roofline_ms(cfg, b, prompt_len, new_tokens)
+        def batched_row(path: str, dt_b: float, b=b, lens=None, paged=False):
+            roof_ms = _decode_roofline_ms(
+                cfg, b, prompt_len, new_tokens, lens=lens,
+                page_block=page_block if paged else None)
             dev_ms = max(dt_b * 1e3 - _DISPATCH_FLOOR_MS, 0.0)
             return {
                 "path": path,
@@ -231,6 +250,42 @@ def benchmark_decode(
                 reps,
             )
             _add(batched_row(f"kv_cache_b{b}_ragged4x{moe_tag}", dt_r))
+        if skew and b >= 2:
+            # SKEWED ragged profiles — the paged path's target workload.
+            # Each profile gets an unpaged row (batch-global attended
+            # prefix, cache alloc b·max_len) and a paged row (per-row
+            # page tables, pool = sum of touched pages) with identical
+            # prompts/keys/sampling, so the pair isolates exactly what
+            # paging buys. The roofline column switches with it: the
+            # paged row's roofline sums per-row pages, the unpaged row's
+            # streams the bucketed max for every row.
+            import numpy as _np
+
+            for mode in skew:
+                if mode == "spike":
+                    # the motivating shape: one long straggler pinning
+                    # (b-1) short rows to its max-length cost
+                    lens_s = _np.full(b, max(prompt_len // 8, 1), int)
+                    lens_s[-1] = prompt_len
+                elif mode == "zipf":
+                    # zipf-ish tail: len_i = P/(i+1) — a few long rows,
+                    # many short ones, mean << max
+                    lens_s = _np.maximum(
+                        prompt_len // (_np.arange(b) + 1), 1)
+                else:
+                    raise ValueError(f"unknown skew profile {mode!r}")
+                for tag, blk in (("", None), ("_paged", page_block)):
+                    dt_s, _ = _time_best(
+                        lambda: generate_kv_batched(
+                            params, cfg, prompts, new_tokens, key,
+                            temperature=0.8, top_k=50, prompt_lens=lens_s,
+                            page_block=blk,
+                        ),
+                        reps,
+                    )
+                    _add(batched_row(
+                        f"kv_cache_b{b}_skew_{mode}{tag}{moe_tag}", dt_s,
+                        lens=lens_s, paged=blk is not None))
 
     if uncached:
         # reference semantics: full forward per token (model.py:283-308)
@@ -275,6 +330,15 @@ def main(argv=None) -> None:
     p.add_argument("--ragged", action="store_true",
                    help="add a ragged-prompt row per batch (per-row "
                         "lengths spread 4x, same padded buffer)")
+    p.add_argument("--skew", nargs="*", default=[],
+                   choices=["spike", "zipf"],
+                   help="add skewed-ragged unpaged+paged row PAIRS per "
+                        "batch: 'spike' = (b-1) rows at P/8 plus one at "
+                        "P, 'zipf' = len_i = P/(i+1). The pair's delta "
+                        "is what per-row paging buys on that profile")
+    p.add_argument("--page-block", type=int, default=128,
+                   help="KV page size (rows) for the paged --skew rows "
+                        "(models/decode.PAGE_BLOCK default)")
     args = p.parse_args(argv)
 
     rows = []
@@ -284,7 +348,8 @@ def main(argv=None) -> None:
             batch_sizes=tuple(args.batches),
             uncached=args.uncached and j == 0,  # the slow baseline once
             reps=args.reps, experts=args.experts, moe_top_k=args.moe_top_k,
-            ragged=args.ragged, out_path=args.out,
+            ragged=args.ragged, skew=tuple(args.skew),
+            page_block=args.page_block, out_path=args.out,
         )
     df = results_table(rows, args.latex)
     print_table(df)

@@ -25,6 +25,7 @@ TPU-first design:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -61,6 +62,76 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int | None = None
     s = max_len or cfg.context_length
     h = num_heads if num_heads is not None else cfg.num_heads
     shape = (batch, h, s, 2 * cfg.d_head)
+    return {
+        "kv": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
+    }
+
+
+# Default page size for the paged KV cache: 128 rows keeps the paged
+# kernel's per-page DMA a full [128, W] tile (the unpaged kernel's slab
+# granularity) while making skewed batches pay per-row page counts.
+PAGE_BLOCK = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVGeometry:
+    """Host-side page-pool layout for one ragged generation: row i owns
+    ``ceil((len_i + new) / block)`` consecutive pages, so the pool holds
+    ``sum`` of those — the HBM win over the unpaged cache's B·max rows.
+
+    ``tables`` [B, max_blocks] int32: row i's page id for block j, with
+    entries past the row's last page CLAMPED to its last page — they are
+    never attended (the kernel early-outs at pos // block) but a prefetch
+    may touch them, so they must stay valid ids of the SAME row and never
+    the pool's reserved write-scratch page. ``page_rows``/``page_blks``
+    [n_pages] invert the tables: the owning batch row and block index of
+    each pool page (what the prefill gather consumes)."""
+
+    block: int
+    n_pages: int       # real pages — the pool allocates n_pages + 1
+    max_blocks: int
+    tables: object     # np [B, max_blocks] int32
+    page_rows: object  # np [n_pages] int32
+    page_blks: object  # np [n_pages] int32
+
+
+def paged_kv_geometry(prompt_lens, max_new_tokens: int,
+                      block: int = PAGE_BLOCK) -> PagedKVGeometry:
+    """Build the page-pool geometry for per-row prompt lengths (host
+    numpy in, host numpy out — shapes feed static jit specialization)."""
+    import numpy as np
+
+    if block <= 0 or block % 8:
+        raise ValueError(
+            f"page block must be a positive multiple of 8 (Mosaic HBM "
+            f"write tiles are 8-row-aligned), got {block}")
+    lens = np.asarray(prompt_lens, np.int64)
+    if lens.ndim != 1 or lens.size == 0:
+        raise ValueError(f"prompt_lens must be a non-empty [B] vector, "
+                         f"got shape {lens.shape}")
+    pages = -(-(lens + max_new_tokens) // block)
+    offs = np.concatenate([[0], np.cumsum(pages)])
+    nb = int(pages.max())
+    b = lens.shape[0]
+    tables = (offs[:b, None]
+              + np.minimum(np.arange(nb)[None, :], pages[:, None] - 1))
+    page_rows = np.repeat(np.arange(b), pages)
+    page_blks = np.concatenate([np.arange(p) for p in pages])
+    return PagedKVGeometry(
+        block, int(pages.sum()), nb, tables.astype(np.int32),
+        page_rows.astype(np.int32), page_blks.astype(np.int32))
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, block: int,
+                        num_heads: int | None = None):
+    """Zeroed paged cache pytree: {"kv"} — a per-layer tuple of packed
+    [n_pages + 1, H, block, 2*Dh] page pools (same lane packing and
+    per-layer-leaf rationale as ``init_kv_cache``). The +1 page is the
+    kernel's reserved write scratch: non-final grid steps steer their
+    output flush there (ops/decode_attention._paged_decode_kernel), so
+    it must never appear in a block table."""
+    h = num_heads if num_heads is not None else cfg.num_heads
+    shape = (n_pages + 1, h, block, 2 * cfg.d_head)
     return {
         "kv": tuple(jnp.zeros(shape, cfg.cdtype) for _ in range(cfg.num_layers)),
     }
@@ -145,6 +216,57 @@ def _attend_update_xla(q, kv_cache, k_new, v_new, pos,
     return o, kv_cache
 
 
+def _resolve_impl_paged(impl: str, block: int, d: int, itemsize: int) -> str:
+    """Paged counterpart of ``_resolve_impl``: "auto" picks the paged
+    Pallas kernel on TPU when the page geometry fits its VMEM plan."""
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"unknown decode attention impl: {impl!r} (want 'auto', "
+            "'pallas' or 'xla' — this is the serving-kernel choice, not "
+            "TransformerConfig.attn_impl)"
+        )
+    if impl == "auto":
+        from cs336_systems_tpu.ops import decode_attention as da
+
+        fits = da.paged_supported(block, d, itemsize)
+        impl = "pallas" if fits and jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+def _attend_update_xla_paged(q, kv_pool, k_new, v_new, pos, tables,
+                             block: int, window: int | None = None):
+    """Portable update+attend on the PAGED pool — the oracle the paged
+    Pallas kernel is tested against, and the CPU/fallback serving path.
+    Scatters each row's packed new column into its current page, gathers
+    the row's pages back into a contiguous [B, H, nb*block, W] view, and
+    runs the shared masked-softmax op with mask ``j <= pos_i`` — the same
+    write-then-attend order as ``_attend_update_xla``, so paged and
+    unpaged XLA decoding are BIT-IDENTICAL: every attended column holds
+    the same value in both layouts and the clamped/duplicate page columns
+    are masked to exact softmax zeros. The gather materializes the
+    contiguous view (fine for CPU tests); the TPU path is the kernel,
+    which never does."""
+    from cs336_systems_tpu.ops.attention import attention_with_lse
+    from cs336_systems_tpu.ops.decode_attention import pack_kv
+
+    b, h, _, d = q.shape
+    nb = tables.shape[1]
+    packed = pack_kv(k_new, v_new)[:, :, 0]  # [B, H, W]
+    page = jnp.take_along_axis(tables, (pos // block)[:, None], axis=1)[:, 0]
+    row = pos % block
+    kv_pool = kv_pool.at[page, :, row, :].set(packed)
+    gathered = kv_pool[tables]  # [B, nb, H, block, W]
+    kv = gathered.transpose(0, 2, 1, 3, 4).reshape(b, h, nb * block, 2 * d)
+    idx = jnp.arange(nb * block)
+    mask = idx[None, :] <= pos[:, None]
+    if window is not None:
+        mask &= pos[:, None] - idx[None, :] < window
+    o = attention_with_lse(
+        q, kv[..., :d], kv[..., d:], mask[:, None, None, :]
+    )[0]
+    return o, kv_pool
+
+
 def _local_heads(attn_params, cfg: TransformerConfig) -> int:
     """Head count from the q-projection weight's output dim — equals
     cfg.num_heads single-device, and the PER-SHARD head count when the
@@ -156,7 +278,8 @@ def _local_heads(attn_params, cfg: TransformerConfig) -> int:
 
 def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
                   attend_len: int | None = None, attn_impl: str = "auto",
-                  reduce_axis: str | None = None):
+                  reduce_axis: str | None = None, tables=None,
+                  page_block: int | None = None):
     """One block on a single-token hidden state; returns (x, kv').
 
     ``kv``: this layer's packed [B, H, S, 2*Dh] cache (init_kv_cache).
@@ -171,7 +294,15 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
     input dim is sharded). None single-device.
 
     ``pos`` scalar (one shared write position) or [B] (ragged serving:
-    per-row position → per-row rope angle and attend mask)."""
+    per-row position → per-row rope angle and attend mask).
+
+    ``page_block``/``tables``: PAGED cache mode — ``kv`` is then the
+    layer's [n_pages + 1, H, page_block, 2*Dh] pool (init_paged_kv_cache)
+    and ``tables`` its [B, n_blocks] block table; ``pos`` must be [B].
+    The fused paged kernel (or its XLA oracle) streams only each row's
+    own pages, so a skewed batch pays sum(ceil(len_i/block)) page reads
+    instead of B·max — ``attend_len`` does not apply (the table IS the
+    per-row bound)."""
     b = x.shape[0]
     dh = cfg.d_head
     h = _local_heads(bp["attn"], cfg)
@@ -187,13 +318,32 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        attend = attend_len if attend_len is not None else kv.shape[-2]
-        impl = _resolve_impl(attn_impl, attend, dh, kv.dtype.itemsize)
         # "kv_update" nests inside "attn": tracekit's phase precedence
         # checks the inner scope first, so the fused update+attend kernel
         # (and the XLA DUS+softmax fallback) land in kv_update, the
         # projections/rope around it in attn.
-        if impl == "pallas":
+        if page_block is not None:
+            impl = _resolve_impl_paged(attn_impl, page_block, dh,
+                                       kv.dtype.itemsize)
+            if impl == "pallas":
+                from cs336_systems_tpu.ops.decode_attention import (
+                    paged_decode_attention_update,
+                )
+
+                with annotate("kv_update"):
+                    attn, kv = paged_decode_attention_update(
+                        q, k, v, kv, tables, pos, window=cfg.attn_window,
+                    )
+            else:
+                with annotate("kv_update"):
+                    attn, kv = _attend_update_xla_paged(
+                        q, kv, k, v, pos, tables, page_block,
+                        cfg.attn_window,
+                    )
+        elif _resolve_impl(attn_impl,
+                           attend_len if attend_len is not None
+                           else kv.shape[-2],
+                           dh, kv.dtype.itemsize) == "pallas":
             from cs336_systems_tpu.ops.decode_attention import (
                 decode_attention_update,
             )
@@ -283,10 +433,14 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
 
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
                 attend_len: int | None = None, attn_impl: str = "auto",
-                reduce_axis: str | None = None):
+                reduce_axis: str | None = None, tables=None,
+                page_block: int | None = None):
     """One incremental step: token_ids [B] at position ``pos`` (scalar
     int32, or [B] per-row positions for ragged serving)
     → (logits [B, vocab] fp32, updated cache).
+
+    ``page_block``/``tables``: paged-cache mode — ``cache`` holds page
+    pools and each row attends only its own pages (see _decode_block).
 
     ``attend_len``: static bound on the filled cache length (pos <
     attend_len); attention reads only that prefix — see
@@ -312,7 +466,7 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
         )
         x, kv = _decode_block(
             bp, x, cache["kv"][l], cos, sin, pos, cfg,
-            attend_len, attn_impl, reduce_axis,
+            attend_len, attn_impl, reduce_axis, tables, page_block,
         )
         kvs.append(kv)
     x = rmsnorm(params["ln_final"], x)
@@ -321,7 +475,8 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
 
 
 def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = None,
-            reduce_axis: str | None = None, prompt_lens=None):
+            reduce_axis: str | None = None, prompt_lens=None,
+            page_block: int | None = None, page_geom=None):
     """Fill the cache with ONE batched forward over the whole prompt (full
     MXU tiles, causal attention), capturing each layer's post-RoPE K/V into
     the cache — identical values to stepwise decoding, since projections
@@ -341,12 +496,20 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     rows [len_i, P), but decoding overwrites them one per step and masks
     j <= pos_i until it does, so they are never attended. The returned
     logits come from each row's LAST REAL token (len_i − 1) and the next
-    position is the [B] vector ``prompt_lens``."""
+    position is the [B] vector ``prompt_lens``.
+
+    ``page_block``/``page_geom``: PAGED cache — the prompt K/V is laid
+    out into a per-layer page pool instead of the contiguous cache.
+    ``page_geom`` is the (tables, page_rows, page_blks) triple from
+    ``paged_kv_geometry``; the pool is built by reshaping the packed
+    prompt into page-shaped slabs and ONE gather over the page axis — no
+    [B, max_len] intermediate, so prefill peak stays at the pool size."""
     b, plen = prompt_ids.shape
     dh = cfg.d_head
     blocks = params["blocks"]  # stacked [L, ...] leaves (scan below)
     h = _local_heads(blocks["attn"], cfg)
-    cache = init_kv_cache(cfg, b, max_len, num_heads=h)
+    cache = None if page_block is not None else init_kv_cache(
+        cfg, b, max_len, num_heads=h)
     cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
     positions = jnp.arange(plen)
 
@@ -406,15 +569,43 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     # prefix (one-time cost at prefill; per-layer leaves — init_kv_cache)
     from cs336_systems_tpu.ops.decode_attention import pack_kv
 
-    with annotate("kv_update"):
-        cache = {
-            "kv": tuple(
-                jax.lax.dynamic_update_slice(
-                    c, pack_kv(ks[l], vs[l]), (0, 0, 0, 0)
-                )
-                for l, c in enumerate(cache["kv"])
-            ),
-        }
+    if page_block is not None:
+        _tables, page_rows, page_blks = page_geom
+        blk = page_block
+        nbp = -(-plen // blk)  # prompt blocks per row
+        pad = nbp * blk - plen
+        # Source page s of the pool is (row page_rows[s], block
+        # page_blks[s]); blocks past the padded prompt (decode-growth
+        # pages) clamp to the row's last prompt block — junk data beyond
+        # every len_i, never attended, overwritten as decode fills them.
+        src = page_rows * nbp + jnp.minimum(page_blks, nbp - 1)
+        with annotate("kv_update"):
+            kv = []
+            for l in range(cfg.num_layers):
+                packed = pack_kv(ks[l], vs[l])  # [B, H, P, W]
+                if pad:
+                    packed = jnp.pad(
+                        packed, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                src_pages = packed.reshape(
+                    b, h, nbp, blk, 2 * dh).transpose(0, 2, 1, 3, 4)
+                src_pages = src_pages.reshape(b * nbp, h, blk, 2 * dh)
+                pool = jnp.concatenate(
+                    [src_pages[src],
+                     jnp.zeros((1, h, blk, 2 * dh), cfg.cdtype)], axis=0)
+                kv.append(pool)
+            cache = {"kv": tuple(kv)}
+        if prompt_lens is None:
+            nxt = jnp.full((b,), plen, jnp.int32)  # paged pos is per-row
+    else:
+        with annotate("kv_update"):
+            cache = {
+                "kv": tuple(
+                    jax.lax.dynamic_update_slice(
+                        c, pack_kv(ks[l], vs[l]), (0, 0, 0, 0)
+                    )
+                    for l, c in enumerate(cache["kv"])
+                ),
+            }
     return logits, cache, nxt
 
 
@@ -537,14 +728,49 @@ def _round_up(n: int, m: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p",
-                     "attn_impl", "approx_top_k", "reduce_axis"),
+                     "attn_impl", "approx_top_k", "reduce_axis",
+                     "page_block"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                    temperature, top_k, top_p=None, attn_impl="auto",
                    approx_top_k=False, row_key_offset=None,
-                   reduce_axis=None, prompt_lens=None):
+                   reduce_axis=None, prompt_lens=None,
+                   page_block=None, page_geom=None):
     plen = prompt_ids.shape[1]
     total = plen + max_new_tokens
+
+    if page_block is not None:
+        # PAGED cache: the pool is sized by sum(pages_i) (host geometry,
+        # page_geom shapes are static), each row attends only its own
+        # pages, and decode positions are per-row — so there is no
+        # batch-global attend bound to bucket: ONE scan covers the whole
+        # generation and per-token KV traffic tracks each row's fill.
+        if prompt_lens is None:
+            prompt_lens = jnp.full((prompt_ids.shape[0],), plen, jnp.int32)
+        tables = jnp.asarray(page_geom[0], jnp.int32)
+        logits, cache, pos = prefill(params, prompt_ids, cfg,
+                                     reduce_axis=reduce_axis,
+                                     prompt_lens=prompt_lens,
+                                     page_block=page_block,
+                                     page_geom=page_geom)
+        params = unstack_blocks(params)
+
+        def body(carry, _):
+            cache, pos, logits, key = carry
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub, temperature, top_k, top_p,
+                          approx_top_k, row_key_offset).astype(jnp.int32)
+            new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
+                                            None, attn_impl, reduce_axis,
+                                            tables, page_block)
+            return (cache, pos + 1, new_logits, key), nxt
+
+        carry = (cache, jnp.asarray(pos, jnp.int32), logits, key)
+        if max_new_tokens == 0:
+            return jnp.zeros((prompt_ids.shape[0], 0), jnp.int32)
+        _, tokens = jax.lax.scan(body, carry, None, length=max_new_tokens)
+        return tokens.T  # [B, T]
+
     # Right-size the cache to this generation (bucket-rounded): decode is
     # cache-bandwidth-bound, so allocating context_length rows and
     # attending over them costs real ms/token when prompt+new << ctx.
@@ -659,6 +885,7 @@ def generate_kv_batched(
     row_keyed: bool = False,
     row_key_offset: int = 0,
     prompt_lens=None,
+    page_block: int | None = None,
 ):
     """Batched KV-cached sampling: ``[B, P]`` prompts → one jit dispatch for
     the whole batch's generation. Decoding is matmul-starved at batch 1
@@ -680,6 +907,13 @@ def generate_kv_batched(
     so a short prompt's generation matches its own single-row call
     token-for-token instead of absorbing the batch max length.
 
+    ``page_block``: PAGED KV cache — the cache becomes a per-layer page
+    pool sized sum(ceil((len_i + new)/block)) pages (paged_kv_geometry)
+    instead of B contiguous max-length rows, and each row's decode
+    attention streams only its own pages. Composes with ``prompt_lens``
+    (without it every row pays the padded width, like the unpaged path);
+    the XLA paged path samples BIT-identical tokens to the unpaged one.
+
     Returns ``[B, max_new_tokens]`` when ``eos_token_id`` is None, else a
     list of per-row arrays truncated at each row's first EOS.
     """
@@ -699,11 +933,24 @@ def generate_kv_batched(
         )
     if prompt_lens is not None:
         prompt_lens = _check_prompt_lens(prompt_lens, ids.shape)
+    page_geom = None
+    if page_block is not None:
+        import numpy as np
+
+        lens_np = (np.asarray(jax.device_get(prompt_lens))
+                   if prompt_lens is not None
+                   else np.full((ids.shape[0],), ids.shape[1]))
+        geom = paged_kv_geometry(lens_np, max_new_tokens, page_block)
+        page_geom = (jnp.asarray(geom.tables), jnp.asarray(geom.page_rows),
+                     jnp.asarray(geom.page_blks))
+        if prompt_lens is None:
+            prompt_lens = jnp.asarray(lens_np, jnp.int32)
     tokens = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
         top_p, attn_impl, approx_top_k,
         row_key_offset=jnp.int32(row_key_offset) if row_keyed else None,
         prompt_lens=prompt_lens,
+        page_block=page_block, page_geom=page_geom,
     )
     if eos_token_id is None:
         return tokens

@@ -187,6 +187,254 @@ def supported(s: int, d: int, itemsize: int) -> bool:
     return _pick_group(1, s, 2 * d, itemsize, d) is not None
 
 
+def _paged_decode_kernel(pos_ref, tbl_ref, qp_ref, newt_ref, kv_ref,
+                         kvtile_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                         scale: float, window: int | None, block: int,
+                         heads_per_row: int):
+    """One grid step of the PAGED decode kernel: G (batch, head) rows of
+    ONE batch row against ONE of its [block, W] cache pages, online-
+    softmax style (flash_attention's m/l/acc scratch idiom), plus the
+    in-place 8-row write-back tile on the row's final page.
+
+    Grid (rows // G, n_blocks), page index j innermost. pos_ref [B] and
+    tbl_ref [B * n_blocks] are scalar-prefetch: the kv BlockSpec clamps
+    its page index at pos // block, so every j past the row's last filled
+    page repeats the previous index and Mosaic SKIPS the DMA — the
+    early-out that makes a skewed batch stream sum(ceil(len_i / block))
+    pages, not B * ceil(max / block). The j > n_last grid steps still
+    execute (the grid is static) but fall through both pl.when bodies.
+
+    kv: ONE page [G, block, W] (leading page dim squeezed by the
+    BlockSpec); kvtile out [G, 8, W] addresses the 8-row tile containing
+    pos on the row's last page at j == n_last and a reserved SCRATCH page
+    everywhere else — interpret mode flushes output blocks on every grid
+    step (not only on index change like Mosaic), so an unsteered map
+    would splat stale VMEM over the real page before j reaches n_last.
+    m/l are [G, 8, 128] fp32 scratch (column 0 live, lane-broadcast like
+    flash_attention.py's forward); acc is [G, 8, W] fp32.
+    """
+    g, _, w = qp_ref.shape
+    j = pl.program_id(1)
+    pos = pos_ref[(pl.program_id(0) * g) // heads_per_row]
+    n_last = pos // block
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j <= n_last)
+    def _stream():
+        s = jax.lax.dot_general(
+            qp_ref[:], kv_ref[:], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * (scale * _LOG2E)  # [G, 8, block]
+        jpos = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        valid = jpos < pos
+        if window is not None:
+            valid &= pos - jpos < window
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        # The explicit where() matters: on an all-masked page (the fresh
+        # page at pos % block == 0) m_new stays _NEG_INF and
+        # exp2(s - m_new) would be exp2(0) = 1 in every lane.
+        p = jnp.where(valid, jnp.exp2(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(kv_ref.dtype), kv_ref[:], (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == n_last)
+    def _finalize():
+        # Runs AFTER _stream in the same grid step (sequential pl.when
+        # bodies), so m/l/acc already fold the final page's prefix rows.
+        s_new = jax.lax.dot_general(
+            qp_ref[:], newt_ref[:], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * (scale * _LOG2E)  # [G, 8, 8] — identical columns
+        m_prev = m_ref[:, :, 0:1]
+        m_f = jnp.maximum(m_prev, jnp.max(s_new, axis=-1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_f)
+        p_new = jnp.exp2(s_new - m_f)
+        # mean over the 8 identical columns == the one true p_new value;
+        # the second dot sums the 8 identical rows of newt, hence the /8.
+        l = (l_ref[:, :, 0:1] * alpha
+             + jnp.mean(p_new, axis=-1, keepdims=True))
+        acc = acc_ref[:] * alpha + jax.lax.dot_general(
+            (p_new / 8.0).astype(newt_ref.dtype), newt_ref[:],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        o_ref[:] = (acc / l)[:, :1, :].astype(o_ref.dtype)
+
+        # merge the new column into the 8-row tile containing pos within
+        # this (final) page and write it back through the aliased,
+        # scratch-steered output block; the rest of the pool is untouched.
+        prow = pos - n_last * block
+        base = (prow // 8) * 8
+        orig = kv_ref[:, pl.dslice(base, 8), :]
+        rowi = jax.lax.broadcasted_iota(jnp.int32, (g, 8, w), 1)
+        kvtile_ref[:] = jnp.where(rowi == prow - base, newt_ref[:], orig)
+
+
+def paged_decode_vmem_bytes(g: int, block: int, w: int,
+                            itemsize: int) -> int:
+    """Static VMEM estimate for the PAGED decode kernel at group ``g``:
+    the double-buffered [g, block, w] page slab plus the small per-row
+    blocks (qp/newt/write-tile, double-buffered) and the fp32 online-
+    softmax scratch. Asserted against ``DECODE_SLAB_BUDGET`` by
+    analysis/vmem.py with the same arithmetic ``_pick_group_paged`` fills
+    toward, so the estimator and the picker cannot drift."""
+    return (2 * g * block * w * itemsize       # page slab, double-buffered
+            + 6 * g * 8 * w * itemsize         # qp/newt/kvtile blocks
+            + g * 8 * w * 4                    # fp32 acc scratch
+            + 2 * g * 8 * 128 * 4)             # fp32 m/l scratch
+
+
+def _pick_group_paged(rows: int, block: int, w: int, itemsize: int,
+                      d: int, head_divisor: int) -> int | None:
+    """Largest group for the paged kernel. Per-row positions are the ONLY
+    mode (the page table is per batch row), so the group must always
+    divide the head count — same contract as the unpaged ragged path.
+    Keeps the fp32 x narrow-head Mosaic cap (see ``_pick_group``)."""
+    if itemsize == 4 and d < 32:
+        groups = (2, 1)
+    else:
+        groups = (96, 48, 32, 24, 16, 12, 8, 6, 4, 3, 2, 1)
+    for g in groups:
+        if head_divisor % g:
+            continue
+        if rows % g == 0 and paged_decode_vmem_bytes(
+                g, block, w, itemsize) <= DECODE_SLAB_BUDGET:
+            return g
+    return None
+
+
+def paged_supported(block: int, d: int, itemsize: int) -> bool:
+    """Whether a page geometry fits the paged kernel's plan: 8-row-
+    aligned pages (Mosaic HBM tiles) whose slab fits VMEM at G=1."""
+    return (block % 8 == 0 and block > 0
+            and _pick_group_paged(1, block, 2 * d, itemsize, d, 1)
+            is not None)
+
+
+def paged_attended_kv_bytes(lens, block: int, w: int, itemsize: int) -> int:
+    """Analytic attended-KV DMA bytes per decode step for the paged
+    kernel: row i streams pos_i // block + 1 pages (the clamped-index
+    early-out contract), so a skewed batch pays sum(ceil), not
+    B * ceil(max). The scale test asserts this against the unpaged
+    kernel's B * attend * w * itemsize."""
+    return sum((int(p) // block + 1) * block * w * itemsize for p in lens)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def paged_decode_attention_update(q, k_new, v_new, kv_pool, tables, pos,
+                                  window: int | None = None,
+                                  interpret: bool | None = None):
+    """Paged counterpart of ``decode_attention_update``. q, k_new, v_new:
+    [B, H, 1, Dh]; kv_pool: [n_pages + 1, H, block, 2*Dh] packed page
+    pool whose LAST page is the reserved write scratch (never referenced
+    by any table — see ``_paged_decode_kernel``); tables: [B, n_blocks]
+    int32 page ids (entries past a row's last page must be valid ids of
+    the SAME row — models/decode.paged_kv_geometry clamps them — they are
+    never attended, only possibly prefetched); pos: [B] int32 per-row
+    write positions -> (o [B, H, 1, Dh], updated kv_pool).
+
+    Attends rows j < pos_i of row i's paged prefix plus the new column,
+    and writes the packed new column at paged row pos_i, in place via the
+    aliased pool (donated scan carry, exactly like the unpaged path).
+    Each grid row streams only ceil((pos_i + 1) / block) pages: the page
+    index map clamps at pos_i // block and Mosaic skips the repeated
+    fetches. The pool's head axis shards under tp like the cache today.
+    """
+    b, h, _, d = q.shape
+    n_alloc, hp, block, w = kv_pool.shape
+    if hp != h:
+        raise ValueError(f"pool head axis {hp} != q heads {h}")
+    if w != 2 * d:
+        raise ValueError(f"packed pool width {w} != 2*d_head ({2 * d})")
+    if block % 8 != 0:
+        raise ValueError(f"page block must be a multiple of 8, got {block}")
+    if tables.shape[0] != b:
+        raise ValueError(
+            f"block table rows {tables.shape[0]} != batch {b}")
+    nb = tables.shape[1]
+    rows = b * h
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g = _pick_group_paged(rows, block, w, kv_pool.dtype.itemsize, d,
+                          head_divisor=h)
+    if g is None:
+        raise ValueError(
+            f"page block [{block}, {w}] ({kv_pool.dtype}) exceeds the "
+            "paged decode kernel's VMEM slab plan; shrink the page block")
+    scale = 1.0 / (d ** 0.5)
+    h_blocks = h // g
+    scratch_page = n_alloc - 1
+
+    qp = jnp.concatenate([q, jnp.zeros_like(q)], axis=-1).reshape(rows, 1, w)
+    qp = jnp.broadcast_to(qp, (rows, 8, w))
+    newt = pack_kv(k_new, v_new).reshape(rows, 1, w)
+    newt = jnp.broadcast_to(newt, (rows, 8, w))
+    # pos is traced, so pos < n_blocks * block cannot be checked at trace
+    # time; clamp so a violation merges into the last page's last tile
+    # instead of indexing past the table. Tables are clamped off the
+    # scratch page for the same defensive reason.
+    pos1 = jnp.minimum(jnp.asarray(pos, jnp.int32), nb * block - 1)
+    tbl = jnp.minimum(jnp.asarray(tables, jnp.int32).reshape(-1),
+                      scratch_page - 1)
+
+    def kv_map(r, j, p, t):
+        bi = (r * g) // h
+        jc = jnp.minimum(j, p[bi] // block)
+        return (t[bi * nb + jc], r % h_blocks, 0, 0)
+
+    def tile_map(r, j, p, t):
+        bi = (r * g) // h
+        n_last = p[bi] // block
+        page = jnp.where(j == n_last, t[bi * nb + n_last], scratch_page)
+        tile = jnp.where(j == n_last, (p[bi] % block) // 8, 0)
+        return (page, r % h_blocks, tile, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(rows // g, nb),
+        in_specs=[
+            pl.BlockSpec((g, 8, w), lambda r, j, p, t: (r, 0, 0)),
+            pl.BlockSpec((g, 8, w), lambda r, j, p, t: (r, 0, 0)),
+            pl.BlockSpec((None, g, block, w), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, g, 8, w), tile_map),
+            # 3-D so the block's trailing dims equal the array's at any g
+            pl.BlockSpec((g, 1, w), lambda r, j, p, t: (r, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, 8, 128), jnp.float32),
+            pltpu.VMEM((g, 8, 128), jnp.float32),
+            pltpu.VMEM((g, 8, w), jnp.float32),
+        ],
+    )
+    kv_out, o = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale, window=window,
+                          block=block, heads_per_row=h),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(kv_pool.shape, kv_pool.dtype),
+            jax.ShapeDtypeStruct((rows, 1, w), q.dtype),
+        ],
+        input_output_aliases={4: 0},  # pool (after pos, tbl, qp, newt)
+        interpret=interpret,
+    )(pos1, tbl, qp, newt, kv_pool)
+    o_v = o[:, 0, d:].reshape(b, h, 1, d)  # V half; [0, d) is p.K garbage
+    return o_v, kv_out
+
+
 @functools.partial(
     jax.jit, static_argnames=("window", "attend_len", "interpret"),
 )

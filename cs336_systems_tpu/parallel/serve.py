@@ -107,7 +107,10 @@ def lint_contract(cfg: TransformerConfig, dp_axis: str | None = None,
 
     - dp only: ZERO collectives — the whole point of the row-keyed
       design (bit-identical rows, nothing crosses the batch axis).
-      Ragged lens change per-row write columns, not communication.
+      Ragged lens change per-row write columns, not communication, and
+      the PAGED cache (page_block) only changes each shard's local cache
+      LAYOUT — block tables and pools never cross the mesh, so the
+      counts below hold verbatim for ``serve_ragged_paged`` too.
     - tp: 2L + 2 psums. The decode scan body unrolls the layer loop
       over the unstacked per-layer params (models/decode._generate_scan)
       — one psum per block pair (attention out-projection + FFN
@@ -148,9 +151,21 @@ def make_sharded_generate(
     attn_impl: str = "auto",
     approx_top_k: bool = False,
     ep_axis: str | None = None,
+    page_block: int | None = None,
 ):
     """Build a jitted sharded generation fn:
     ``(params, prompt_ids [B, P], key) -> tokens [B, max_new_tokens]``.
+
+    ``page_block``: PAGED KV cache (models/decode paged path) — each dp
+    shard allocates a page POOL sized by ITS rows' lengths instead of
+    B_local contiguous max-length rows, and every row's decode attention
+    streams only its own pages. Geometry is computed per dp shard on the
+    host with SHARD-LOCAL page ids; SPMD needs one program, so every
+    shard's pool takes the max local page count (skew ACROSS shards pays
+    the max; skew within a shard pays sum). Block tables/lens shard with
+    their rows over dp and replicate over tp/ep; the pool shards exactly
+    like the cache today (head axis over tp, rows over dp). ZERO extra
+    collectives — same ``lint_contract`` counts.
 
     ``dp_axis``: mesh axis the batch shards over (B divisible by its
     size); None = no batch sharding. ``tp_axis``: mesh axis the heads /
@@ -229,27 +244,40 @@ def make_sharded_generate(
     batch_spec = P(dp_axis) if dp_axis is not None else P()
     temperature = float(temperature)
 
-    def local(params, ids, key, lens=None):
+    def local(params, ids, key, lens=None, tables=None, page_rows=None,
+              page_blks=None):
         if dp_axis is not None:
             off = jax.lax.axis_index(dp_axis) * ids.shape[0]
         else:
             off = jnp.int32(0)
+        page_geom = (None if page_block is None
+                     else (tables, page_rows, page_blks))
         return _generate_scan(
             params, ids, key, cfg, max_new_tokens, temperature,
             top_k, top_p, attn_impl, approx_top_k,
             row_key_offset=off, reduce_axis=tp_axis, prompt_lens=lens,
+            page_block=page_block, page_geom=page_geom,
         )
 
     # shard_map in_specs are static, so the uniform and ragged entries are
-    # two programs; built lazily and cached (the common case pays for one)
+    # two programs; built lazily and cached (the common case pays for one).
+    # Paged serving is ONE entry: lens/tables always ride along (a uniform
+    # batch is just the degenerate geometry).
     fns = {}
 
-    def build(ragged: bool):
-        in_specs = (pspecs, batch_spec, P())
-        f = local
-        if ragged:
-            in_specs += (batch_spec,)  # lens shard with their rows
+    def build(entry):
+        if entry == "paged":
+            # lens + block tables shard with their rows; the page_rows/
+            # page_blks inversion shards on its (per-shard-pool) leading
+            # dim — shard k's pool segment follows shard k's rows.
+            in_specs = (pspecs, batch_spec, P(), batch_spec, batch_spec,
+                        batch_spec, batch_spec)
+            f = local
+        elif entry:  # ragged unpaged
+            in_specs = (pspecs, batch_spec, P(), batch_spec)
+            f = lambda params, ids, key, lens: local(params, ids, key, lens)
         else:
+            in_specs = (pspecs, batch_spec, P())
             f = lambda params, ids, key: local(params, ids, key)
         return jax.jit(shard_map(
             f,
@@ -274,6 +302,52 @@ def make_sharded_generate(
                 f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds context_length={cfg.context_length}"
             )
+        if page_block is not None:
+            import numpy as np
+
+            from cs336_systems_tpu.models.decode import (
+                _check_prompt_lens,
+                paged_kv_geometry,
+            )
+
+            if prompt_lens is not None:
+                _check_prompt_lens(prompt_lens, prompt_ids.shape)
+                # geometry needs HOST values (shapes feed the jit key);
+                # np.asarray, not device_get, so a closed-over host array
+                # stays host even when run() itself is being traced
+                lens_np = np.asarray(prompt_lens, np.int64)
+            else:
+                lens_np = np.full((b,), prompt_ids.shape[1])
+            dp = mesh.shape[dp_axis] if dp_axis is not None else 1
+            per = b // dp
+            geoms = [paged_kv_geometry(lens_np[k * per:(k + 1) * per],
+                                       max_new_tokens, page_block)
+                     for k in range(dp)]
+            # SPMD runs one program on every shard, so each shard's pool
+            # takes the max LOCAL page count; page ids are shard-local.
+            npl = max(g.n_pages for g in geoms)
+            nbg = max(g.max_blocks for g in geoms)
+            tables = np.zeros((b, nbg), np.int32)
+            prows = np.zeros((dp * npl,), np.int32)
+            pblks = np.zeros((dp * npl,), np.int32)
+            for k, g in enumerate(geoms):
+                t = g.tables
+                if g.max_blocks < nbg:
+                    # pad like paged_kv_geometry clamps: the row's last page
+                    t = np.concatenate(
+                        [t, np.repeat(t[:, -1:], nbg - g.max_blocks, 1)],
+                        axis=1)
+                tables[k * per:(k + 1) * per] = t
+                # pool pages past shard k's real count keep row 0/block 0
+                # (valid gather sources, never referenced by any table)
+                prows[k * npl:k * npl + g.n_pages] = g.page_rows
+                pblks[k * npl:k * npl + g.n_pages] = g.page_blks
+            if "paged" not in fns:
+                fns["paged"] = build("paged")
+            return fns["paged"](
+                params, jnp.asarray(prompt_ids, jnp.int32), key,
+                jnp.asarray(lens_np, jnp.int32), jnp.asarray(tables),
+                jnp.asarray(prows), jnp.asarray(pblks))
         ragged = prompt_lens is not None
         if ragged not in fns:
             fns[ragged] = build(ragged)

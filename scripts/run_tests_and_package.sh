@@ -51,6 +51,22 @@ if [ "$mem_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$mem_status
 
+# paged-serving gate: the skewed ragged family through BOTH analysis
+# pipelines — a traced StepProfile (phase attribution must see the paged
+# kv-update scopes) and an analyzed memprofile under the family's HBM
+# budget (the paged pool's whole point is the kv-cache line item).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.trace_cli --step serve_ragged_paged \
+    --iters 1 --out /tmp/paged_smoke.stepprofile.json
+paged_status=$?
+if [ "$paged_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.mem_cli --step serve_ragged_paged \
+        --out /tmp/paged_smoke.memprofile.json
+    paged_status=$?
+fi
+[ "$status" -eq 0 ] && status=$paged_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -1,0 +1,352 @@
+"""Paged KV-cache decode tests (per-row attended-prefix serving).
+
+The paging contract, each piece oracle-tested:
+
+- geometry: row i owns ceil((len_i + new) / block) consecutive pages;
+  table entries past a row's last page clamp to that page (valid
+  prefetch targets, never attended, never the write-scratch page).
+- kernel: the paged Pallas kernel (interpret mode — CI has no TPU) must
+  match ``_attend_update_xla_paged``, the portable scatter/gather
+  oracle, which in turn is BIT-IDENTICAL to the unpaged XLA path — so
+  paged generation, at any skew, draws exactly the tokens the unpaged
+  path draws.  Paging is a layout, not an approximation: the same
+  discipline as the sharding tests.
+- memory: the whole point — memkit's analyzed kv-cache bytes for the
+  skewed registry family must undercut the unpaged twin by at least the
+  analytic pool margin (sum of touched pages vs B·max rows).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs336_systems_tpu.models.decode import (
+    _attend_update_xla_paged,
+    generate_kv_batched,
+    init_paged_kv_cache,
+    paged_kv_geometry,
+)
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.ops import decode_attention as da
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.parallel.serve import make_sharded_generate
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+
+# the skewed profile every generation test reuses: spread 12x, two rows
+# at the max so the bucket boundary is shared, one length-1 row
+SKEW_LENS = np.asarray([12, 3, 7, 1, 12, 5, 9, 2])
+
+
+# --- geometry ---------------------------------------------------------------
+
+
+def test_paged_geometry_hand_computed():
+    # lens [3, 12, 6] + new 4, block 8 -> pages ceil([7,16,10]/8) = [1,2,2]
+    g = paged_kv_geometry([3, 12, 6], 4, block=8)
+    assert (g.block, g.n_pages, g.max_blocks) == (8, 5, 2)
+    # row 0 has ONE page: its second table entry clamps to its own page 0
+    np.testing.assert_array_equal(g.tables, [[0, 0], [1, 2], [3, 4]])
+    np.testing.assert_array_equal(g.page_rows, [0, 1, 1, 2, 2])
+    np.testing.assert_array_equal(g.page_blks, [0, 0, 1, 0, 1])
+
+
+def test_paged_geometry_validation():
+    with pytest.raises(ValueError, match="multiple of 8"):
+        paged_kv_geometry([4, 4], 2, block=12)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        paged_kv_geometry([4, 4], 2, block=0)
+    with pytest.raises(ValueError, match="non-empty"):
+        paged_kv_geometry([], 2, block=8)
+    with pytest.raises(ValueError, match="non-empty"):
+        paged_kv_geometry([[3, 4]], 2, block=8)
+
+
+def test_paged_pool_shape_and_scratch_page():
+    g = paged_kv_geometry([3, 12, 6], 4, block=8)
+    cache = init_paged_kv_cache(CFG, g.n_pages, g.block)
+    assert len(cache["kv"]) == CFG.num_layers
+    # +1 page: the kernel's reserved write scratch, never in a table
+    assert cache["kv"][0].shape == (g.n_pages + 1, CFG.num_heads, g.block,
+                                    2 * CFG.d_head)
+    assert g.tables.max() < g.n_pages
+
+
+def test_paged_attended_kv_bytes_tracks_sum_not_max():
+    # one 1000-token straggler among 8-token rows: the paged DMA bytes
+    # follow sum(ceil(len_i/block)), the unpaged kernel's follow B*max
+    lens = [8, 1000, 8, 8]
+    w, it = 256, 2
+    paged = da.paged_attended_kv_bytes(lens, 128, w, it)
+    unpaged = len(lens) * 1024 * w * it  # B * bucketed-max rows
+    assert paged == (1 + 8 + 1 + 1) * 128 * w * it
+    assert paged < 0.4 * unpaged
+
+
+# --- kernel vs the XLA paged oracle (interpret mode) ------------------------
+
+
+@pytest.mark.parametrize("pos,window", [
+    ([3, 8, 17, 25], None),   # mid-page, page start (pos%block==0), deep
+    ([0, 15, 31, 39], None),  # first token ever + last-row-of-page cases
+    ([3, 8, 17, 25], 8),      # sliding window crossing page boundaries
+])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_paged_kernel_matches_oracle(pos, window, dtype):
+    # fp32 d_head=16 exercises the narrow-head group cap (g<=2); bf16
+    # takes the full group ladder (g=4 at these shapes)
+    b, h, d, block = 4, 4, 16, 8
+    g = paged_kv_geometry([3, 8, 17, 25], 8, block=block)
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (b, h, 1, d), dtype)
+    k_new = jax.random.normal(ks[1], (b, h, 1, d), dtype)
+    v_new = jax.random.normal(ks[2], (b, h, 1, d), dtype)
+    pool = jax.random.normal(
+        ks[3], (g.n_pages + 1, h, block, 2 * d), dtype)
+    tables = jnp.asarray(g.tables, jnp.int32)
+    posv = jnp.asarray(pos, jnp.int32)
+
+    o_ref, pool_ref = _attend_update_xla_paged(
+        q, pool, k_new, v_new, posv, tables, block, window=window)
+    o_got, pool_got = da.paged_decode_attention_update(
+        q, k_new, v_new, pool, tables, posv, window=window)
+
+    tol = dict(rtol=2e-2, atol=2e-2) if dtype == "bfloat16" else \
+        dict(rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(o_got, np.float32), np.asarray(o_ref, np.float32), **tol)
+    # the pool write is a plain store: REAL pages bit-exact (the scratch
+    # page absorbs interpret-mode's every-step output flushes — excluded)
+    np.testing.assert_array_equal(
+        np.asarray(pool_got[:g.n_pages]), np.asarray(pool_ref[:g.n_pages]))
+
+
+def test_paged_kernel_validation():
+    b, h, d, block = 2, 4, 16, 8
+    g = paged_kv_geometry([3, 4], 4, block=block)
+    q = jnp.zeros((b, h, 1, d))
+    pool = jnp.zeros((g.n_pages + 1, h, block, 2 * d))
+    tables = jnp.asarray(g.tables, jnp.int32)
+    pos = jnp.asarray([3, 4], jnp.int32)
+    with pytest.raises(ValueError, match="head axis"):
+        da.paged_decode_attention_update(
+            q, q, q, pool[:, :2], tables, pos)
+    with pytest.raises(ValueError, match="table rows"):
+        da.paged_decode_attention_update(
+            q, q, q, pool, tables[:1], pos)
+
+
+def test_paged_supported_gate():
+    assert da.paged_supported(128, 128, 2)
+    assert da.paged_supported(128, 128, 4)
+    assert not da.paged_supported(12, 128, 2)   # not 8-row-aligned
+
+
+# --- paged generation == unpaged generation (bit-exact) ---------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(0), CFG)
+
+
+def _prompts(batch=8, plen=12, seed=0):
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (batch, plen), 0, CFG.vocab_size)
+    key = jax.random.PRNGKey(seed + 2)
+    return prompts, key
+
+
+@pytest.mark.parametrize("lens", [None, SKEW_LENS],
+                         ids=["uniform", "skewed"])
+def test_paged_generate_matches_unpaged(params, lens):
+    """Same prompts, keys and sampling; only the cache layout differs.
+    Every attended column holds the same value in both layouts and the
+    clamped/junk page columns are masked to exact softmax zeros, so the
+    token streams are IDENTICAL — at uniform lengths and at 12x skew."""
+    prompts, key = _prompts()
+    want = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=lens))
+    got = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=lens, page_block=8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_paged_generate_pallas_matches_xla(params):
+    """The paged kernel (interpret mode) inside full generation: forced
+    attn_impl='pallas' must draw the same tokens as the XLA paged path —
+    which the test above pins to the unpaged path."""
+    prompts, key = _prompts()
+    kw = dict(temperature=0.9, top_k=8, row_keyed=True,
+              prompt_lens=SKEW_LENS, page_block=8)
+    want = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, attn_impl="xla", **kw))
+    got = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, attn_impl="pallas", **kw))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_paged_generate_windowed(params):
+    """Sliding-window attention composes with paging: the window mask is
+    applied over the gathered per-row prefix exactly as over the
+    contiguous cache."""
+    cfg = dataclasses.replace(CFG, attn_window=8)
+    wparams = init_transformer_lm(jax.random.PRNGKey(3), cfg)
+    prompts, key = _prompts()
+    want = np.asarray(generate_kv_batched(
+        wparams, cfg, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=SKEW_LENS))
+    got = np.asarray(generate_kv_batched(
+        wparams, cfg, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=SKEW_LENS, page_block=8))
+    np.testing.assert_array_equal(got, want)
+
+
+# --- sharded paged serving --------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+])
+def test_sharded_paged_matches_single_device(params, mesh_axes, dp, tp):
+    """Paged serving through the dp/tp server: per-shard page pools
+    (shard-local ids, SPMD max-sized), tokens bit-equal to the
+    single-device UNPAGED row-keyed path — paging plus sharding is still
+    just a layout."""
+    prompts, key = _prompts()
+    want = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=SKEW_LENS))
+    mesh = make_mesh(mesh_axes)
+    gen = make_sharded_generate(
+        CFG, mesh, max_new_tokens=10, dp_axis=dp, tp_axis=tp,
+        temperature=0.9, top_k=8, page_block=8)
+    got = np.asarray(gen(params, prompts, key, prompt_lens=SKEW_LENS))
+    np.testing.assert_array_equal(got, want)
+    # the paged server also takes uniform batches (lens synthesized)
+    got_u = np.asarray(gen(params, prompts, key))
+    want_u = np.asarray(generate_kv_batched(
+        params, CFG, prompts, 10, key, temperature=0.9, top_k=8,
+        row_keyed=True))
+    np.testing.assert_array_equal(got_u, want_u)
+
+
+def test_sharded_paged_moe_expert_sharded():
+    """Paged serving composed with expert sharding (dp x ep): the page
+    pool shards with its batch rows over dp and replicates over ep, the
+    MoE combine psum is untouched — bit-identical at top_k=2."""
+    cfg = dataclasses.replace(CFG, num_experts=8, moe_top_k=2)
+    mparams = init_transformer_lm(jax.random.PRNGKey(5), cfg)
+    prompts, key = _prompts()
+    lens = np.asarray([3, 6, 2, 5, 12, 4, 1, 6])
+    want = np.asarray(generate_kv_batched(
+        mparams, cfg, prompts, 8, key, temperature=0.9, top_k=8,
+        row_keyed=True, prompt_lens=lens))
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    gen = make_sharded_generate(cfg, mesh, max_new_tokens=8, dp_axis="dp",
+                                ep_axis="ep", temperature=0.9, top_k=8,
+                                page_block=8)
+    got = np.asarray(gen(mparams, prompts, key, prompt_lens=lens))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_paged_block_validation():
+    gen = make_sharded_generate(CFG, make_mesh({"dp": 4}),
+                                max_new_tokens=4, page_block=12)
+    p = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    prompts, key = _prompts(batch=4)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        gen(p, prompts, key)
+
+
+# --- the memory claim: pool bytes vs B*max ----------------------------------
+
+
+def test_memkit_paged_pool_beats_unpaged_cache():
+    """The headline assertion: memkit's analyzed kv-cache bytes for the
+    skewed serve_ragged_paged family must undercut an UNPAGED server on
+    the identical workload by at least the analytic pool margin.
+
+    Registry shape (analysis/registry.serve_ragged_lens): 8 rows over
+    dp=8, lens [6,2,...,2], max_new 4, 8-row pages -> each shard's pool
+    is max-local 2 pages + 1 scratch = 24 rows, vs the unpaged path's
+    64-row bucket-rounded alloc. Margin per shard per layer:
+    40 rows x H4 x W16 x 4B = 10240, x L2 = 20480 bytes."""
+    from cs336_systems_tpu.analysis import memkit, registry
+
+    paged = memkit.profile_family("serve_ragged_paged")
+    paged_kv = paged["composition_bytes"].get("kv-cache", 0)
+    assert paged_kv > 0  # the pool is seen and classified
+
+    # unpaged twin: same mesh, lens, sampling — only page_block dropped
+    cfg = registry._tiny_cfg()
+    gen = make_sharded_generate(
+        cfg, make_mesh({"dp": 8}), max_new_tokens=4, dp_axis="dp",
+        temperature=0.9, top_k=8)
+    lens = registry.serve_ragged_lens(True)
+    fn = lambda p, i, k: gen(p, i, k, prompt_lens=lens)
+    params = registry._abstract_params(cfg)
+    ids = jax.ShapeDtypeStruct((8, 6), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    unpaged = memkit.profile_callable(
+        fn, (params, ids, key), family="serve_ragged_unpaged_twin",
+        arg_classes=memkit._serve_arg_classes(), n_devices=8)
+    unpaged_kv = unpaged["composition_bytes"].get("kv-cache", 0)
+
+    w = 2 * cfg.d_head
+    itemsize = jnp.dtype(cfg.cdtype).itemsize
+    margin = 40 * cfg.num_heads * w * itemsize * cfg.num_layers
+    assert unpaged_kv - paged_kv >= margin, (
+        f"paged kv-cache {paged_kv} vs unpaged {unpaged_kv}: margin "
+        f"{unpaged_kv - paged_kv} < analytic pool margin {margin}")
+
+
+# --- analysis wiring --------------------------------------------------------
+
+
+def test_ragged_decode_flops_mean_of_lens():
+    from cs336_systems_tpu.analysis.flops import decode_flops_per_token
+    from cs336_systems_tpu.analysis.registry import (
+        _tiny_cfg,
+        serve_ragged_lens,
+    )
+
+    cfg = _tiny_cfg()
+    lens = serve_ragged_lens(True) + 4  # prompt + max_new, as tracekit does
+    got = decode_flops_per_token(cfg, attend_lens=lens)
+    # per-token share of the batch's attention work is the MEAN length
+    assert got == decode_flops_per_token(cfg,
+                                         attend_len=float(np.mean(lens)))
+    # a skewed batch must NOT be billed at its max
+    assert got < decode_flops_per_token(cfg, attend_len=int(lens.max()))
+    with pytest.raises(ValueError, match="not both"):
+        decode_flops_per_token(cfg, attend_len=8, attend_lens=lens)
+
+
+def test_tracekit_paged_family_flops_crosscheck():
+    """tracekit's serve_ragged_paged MFU denominator must be the
+    per-row-lens FLOPs model — registry lens in, mean-of-lens out."""
+    from cs336_systems_tpu.analysis import tracekit
+    from cs336_systems_tpu.analysis.flops import decode_flops_per_token
+    from cs336_systems_tpu.analysis.registry import (
+        _tiny_cfg,
+        serve_ragged_lens,
+    )
+
+    runner = tracekit.FAMILIES["serve_ragged_paged"]()
+    want = decode_flops_per_token(
+        _tiny_cfg(), attend_lens=serve_ragged_lens(True) + 4)
+    assert runner.flops_per_token == want
