@@ -23,6 +23,17 @@ Every rule here encodes a regression the chip already taught us
   with the SiLU grads in-register; an extra call or a host-program
   ``logistic`` is the five-pass dh/dg HBM round-trip coming back
   (the round-5 b48-OOM live set).
+- ``grad-reduction`` — every explicit-sync family must reduce its
+  gradient leaves over the data axes EXACTLY once, with mean
+  normalization. Under this jax's forced ``check_rep=False``
+  (_compat.py), in-body ``value_and_grad`` yields LOCAL per-device
+  gradients — the loss pmean's 1/W cancels against its own psum
+  transpose — so a builder that forgets the sync trains every replica
+  on its own shard's gradient while the forward loss still matches:
+  the a2a/sp parity regression (six xfail pins, ~40% first-step sign
+  flips) that analysis/gradsan root-caused. The rule keys on the
+  ``annotate("grad_sync")`` scope every gradient reduction runs under
+  (parallel/dp.sync_grads, parallel/ep._sync_ep_grads).
 """
 
 from __future__ import annotations
@@ -179,6 +190,72 @@ def check_phase_scopes(name: str, jaxpr, expected) -> list[Violation]:
         "would attribute that phase's device time to 'other'; restore the "
         "annotate(...) scope (models/ or train.make_update_fn)",
     )]
+
+
+def check_grad_reduction(name: str, jaxpr, contract: dict) -> list[Violation]:
+    """Gradient psums — the ones inside an ``annotate("grad_sync")``
+    scope — must (a) number exactly ``contract["count"]`` call sites,
+    (b) reduce only over ``contract["axes"]``, and (c) each feed a
+    ``div``/``mul`` in the same jaxpr (the mean normalization:
+    ``lax.pmean`` traces to psum + div; ep's expert leaves psum over dp
+    then scale by 1/ep_degree).
+
+    Too few sites is the historical local-gradients defect (each device
+    runs AdamW on its own shard's gradient; see module docstring); too
+    many is a double reduction (a W× gradient scale); a scoped psum with
+    no div/mul consumer is a sum where a mean belongs (same W× scale,
+    different spelling). The count is derived from the SAME
+    ``collective_groups`` the step issues from (dp/ep ``lint_contract``),
+    so expectation and issuance cannot drift independently."""
+    want_count = int(contract["count"])
+    want_axes = set(contract["axes"])
+    scoped: list[tuple] = []  # (eqn, owning core jaxpr)
+
+    def walk(jx):
+        core = jx.jaxpr if hasattr(jx, "jaxpr") else jx
+        for eqn in core.eqns:
+            if eqn.primitive.name == "psum":
+                stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+                if "grad_sync" in stack:
+                    scoped.append((eqn, core))
+            for sub in jaxpr_scan._sub_jaxprs(eqn.params):
+                walk(sub)
+
+    walk(jaxpr)
+    out = []
+    if len(scoped) != want_count:
+        diagnosis = (
+            "gradient leaves are syncing MORE than once — a double-psum "
+            "scales every gradient by the axis size"
+            if len(scoped) > want_count else
+            "gradient leaves are missing their reduction — under "
+            "check_rep=False each device trains on its LOCAL gradient "
+            "(the a2a/sp parity regression); run analysis.gradsan on "
+            "this family to see the first divergent (stage, leaf)")
+        out.append(Violation(
+            "grad-reduction", name,
+            f"{len(scoped)} grad_sync-scoped psum site(s), contract says "
+            f"{want_count} — {diagnosis}"))
+    for eqn, core in scoped:
+        axes = set(eqn.params.get("axes", ()))
+        if not axes <= want_axes:
+            out.append(Violation(
+                "grad-reduction", name,
+                f"grad_sync psum reduces over {sorted(axes)} but the "
+                f"family's data axes are {sorted(want_axes)} — reducing "
+                "over a non-data axis averages away real model-parallel "
+                "gradient structure"))
+        consumers = [
+            e for e in core.eqns
+            if any(v in e.invars for v in eqn.outvars)]
+        if not any(e.primitive.name in ("div", "mul") for e in consumers):
+            out.append(Violation(
+                "grad-reduction", name,
+                f"grad_sync psum over {sorted(axes)} has no div/mul "
+                "consumer — a SUM where a MEAN belongs scales gradients "
+                f"by the axis-size product (use lax.pmean, or scale by "
+                "1/degree as ep's expert path does)"))
+    return out
 
 
 # A dot is "big" when M, N and K are ALL at least this: the fp32 router

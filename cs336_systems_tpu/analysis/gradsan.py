@@ -1,0 +1,403 @@
+"""gradsan — stage-level differential numerics sanitizer for the
+registered TRAINING families.
+
+The fourth analysis tool (graft-lint / tracekit / memkit / gradsan):
+where the others gate collectives, device time and HBM, gradsan gates
+CORRECTNESS of the composed sharded train steps — the class of defect
+that produced the a2a/sp post-AdamW parity regression (six xfail pins,
+~40% first-step sign flips bounded by 2·lr while the forward loss
+matched at rtol 1e-5).
+
+How it works: every parallelism layer wraps ``train.make_update_fn``,
+which (``capture_stages=True``) exposes the canonical intermediate
+values of one update as a stage dict — ``loss``, ``grads`` (post-sync,
+pre-clip), ``grad_norm``, ``clipped_grads``, ``adamw_delta``,
+``new_m``/``new_v``. gradsan builds the sharded step AND the
+single-device oracle with capture on, runs both on the SAME global
+batch on the hermetic 8-virtual-device CPU mesh, and reports the FIRST
+divergent (stage, leaf) in pipeline order with max-abs and fp32-ulp
+diffs. A defect localizes immediately: a missing gradient reduction
+diverges at ``grads`` (forward loss still clean); a broken clip norm at
+``clipped_grads``; a schedule/AdamW drift at ``adamw_delta`` with every
+earlier stage clean.
+
+Two tolerance classes (the repo's, per tests/test_pp.py): the
+gradient-level stages (loss → clipped_grads) compare near-exact
+(cross-shard reassociation only), the post-AdamW stages (delta,
+moments) at the ε-amplification class — ``m/sqrt(v)`` near zero
+gradient amplifies last-ulp flips to O(lr), so 5e-4 absolute at lr=1e-3
+is signal, not slop. A real missing-reduction defect moves deltas by
+2·lr and is far outside either class.
+
+This found the root cause recorded in the module docstrings of
+parallel/sp.py and parallel/ep.py: this jax's shard_map is forced to
+``check_rep=False`` (_compat.py), under which in-body
+``value_and_grad`` yields LOCAL per-device gradients — the loss pmean's
+1/W cancels against its own psum transpose — so any builder that skips
+an explicit grad sync silently runs AdamW on per-shard gradients while
+``out_specs=P()`` returns device 0's fork. The static side of the same
+invariant is the ``grad-reduction`` lint rule (analysis/contracts.py).
+
+CLI (also ``python -m cs336_systems_tpu.analysis.gradsan``):
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m cs336_systems_tpu.analysis.gradsan --step train_ep_a2a
+    ... --step train_tp_sp --json      # machine report
+    ... --list                         # families + mutations
+    ... --step train_sp --mutate drop-grad-sync   # must exit 1
+
+Exit status: 0 clean, 1 divergent (first (stage, leaf) named), 2 the
+family failed to build/run. ``--mutate`` re-injects a known defect
+(dropped grad sync = the historical bug, a double-psum, a sharded-side
+LR skew) to prove the tool localizes it — the same seams
+tests/test_gradsan.py pins.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (the site
+# TPU plugin must not grab the tunneled chip for a numerics diff) — same
+# pattern as analysis/lint.py; CS336_TPU_GRADSAN=1 opts out.
+if not os.environ.get("CS336_TPU_GRADSAN"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import contextlib
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if not os.environ.get("CS336_TPU_GRADSAN"):
+    jax.config.update("jax_platforms", "cpu")
+
+# Canonical pipeline order — divergence is reported at the FIRST failing
+# stage, so a defect in the backward never masquerades as an optimizer
+# defect (every stage after the first divergence is downstream damage).
+STAGE_ORDER = ("loss", "grads", "grad_norm", "clipped_grads",
+               "adamw_delta", "new_m", "new_v")
+
+# Tolerance classes (tests/test_pp.py): gradient-level stages are
+# near-exact across resharding reassociation; post-AdamW stages carry
+# the eps-amplification bound (ADAMW_ATOL there) — near-zero grads turn
+# last-ulp flips into O(alpha_t) = O(lr·sqrt(1-b2)/(1-b1)) deltas.
+GRAD_STAGES = ("loss", "grads", "grad_norm", "clipped_grads")
+GRAD_RTOL, GRAD_ATOL = 1e-4, 1e-5
+ADAMW_RTOL, ADAMW_ATOL = 1e-3, 5e-4
+
+MUTATIONS = ("drop-grad-sync", "double-psum", "optimizer-lr")
+
+
+def _tolerance(stage: str) -> tuple[str, float, float]:
+    if stage in GRAD_STAGES:
+        return "grad-level", GRAD_RTOL, GRAD_ATOL
+    return "post-adamw", ADAMW_RTOL, ADAMW_ATOL
+
+
+def _ulp_diff(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Element-wise distance in fp32 ulps: both operands cast to fp32,
+    bit patterns mapped to the monotone integer line (sign-magnitude →
+    offset), then differenced. bf16 leaves therefore report in fp32-ulp
+    units — coarser than a bf16 ulp, but uniform across a mixed tree."""
+    ia = np.asarray(a, np.float32).view(np.int32).astype(np.int64)
+    ib = np.asarray(b, np.float32).view(np.int32).astype(np.int64)
+    ia = np.where(ia < 0, np.int64(-(1 << 31)) - ia, ia)
+    ib = np.where(ib < 0, np.int64(-(1 << 31)) - ib, ib)
+    return np.abs(ia - ib)
+
+
+def _key_name(k) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _leaf_items(tree) -> list[tuple[str, np.ndarray]]:
+    """(path, np array) per leaf, '/'-joined dict keys; a bare scalar
+    (loss, grad_norm) is one leaf with path ''."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out.append(("/".join(_key_name(k) for k in path), np.asarray(leaf)))
+    return out
+
+
+def diff_stages(oracle_stages: dict, sharded_stages: dict) -> dict:
+    """Compare two captured stage dicts in pipeline order. Returns the
+    report dict (schema ``gradsan/v1``): per-stage worst diffs and the
+    first divergent (stage, leaf) under that stage's tolerance class."""
+    stages_report = []
+    first = None
+    for stage in STAGE_ORDER:
+        klass, rtol, atol = _tolerance(stage)
+        ref_leaves = _leaf_items(oracle_stages[stage])
+        got_leaves = _leaf_items(sharded_stages[stage])
+        ref_names = [n for n, _ in ref_leaves]
+        if ref_names != [n for n, _ in got_leaves]:
+            raise ValueError(
+                f"stage {stage!r}: sharded/oracle leaf structures differ "
+                f"({len(got_leaves)} vs {len(ref_leaves)} leaves)")
+        worst = {"leaf": None, "max_abs": 0.0, "max_ulp": 0, "n_bad": 0}
+        stage_first = None
+        n_total = 0
+        for (name, ref), (_, got) in zip(ref_leaves, got_leaves):
+            ref64 = ref.astype(np.float64)
+            got64 = got.astype(np.float64)
+            bad = ~np.isclose(got64, ref64, rtol=rtol, atol=atol)
+            n_bad = int(np.sum(bad))
+            n_total += ref.size
+            max_abs = float(np.max(np.abs(got64 - ref64))) if ref.size else 0.0
+            max_ulp = int(np.max(_ulp_diff(got, ref))) if ref.size else 0
+            if max_abs > worst["max_abs"] or worst["leaf"] is None:
+                worst = {"leaf": name, "max_abs": max_abs,
+                         "max_ulp": max_ulp, "n_bad": n_bad}
+            if n_bad and stage_first is None:
+                stage_first = {"stage": stage, "leaf": name,
+                               "tolerance": klass, "rtol": rtol, "atol": atol,
+                               "max_abs": max_abs, "max_ulp": max_ulp,
+                               "n_bad": n_bad, "n_elements": int(ref.size)}
+        stages_report.append({
+            "stage": stage, "tolerance": klass, "clean": stage_first is None,
+            "n_elements": n_total, **worst,
+        })
+        if first is None and stage_first is not None:
+            first = stage_first
+    return {
+        "schema": "gradsan/v1",
+        "clean": first is None,
+        "first_divergence": first,
+        "stages": stages_report,
+    }
+
+
+# --- concrete family runners ------------------------------------------------
+#
+# Mirrors the oracle tests exactly (tests/test_tp_sp.py /
+# tests/test_moe_ep.py): same init seeds, same global batch on both
+# sides, donate off, capture on. Single-device families self-diff (two
+# independent builds of the same step) — a non-trivial check of capture
+# determinism, and the seam the optimizer-lr mutation drives.
+
+
+def _hp(mutate: str | None):
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+
+    hp = AdamWHparams(lr=1e-3)
+    # sharded-side-only LR skew: every gradient stage stays clean and the
+    # first divergence lands at adamw_delta — the wrong-stage probe. 2×
+    # so the ~lr-sized delta shift clears the post-adamw atol (5e-4) by
+    # 2× — a 1% skew (1e-5 shift) would sit inside the tolerance class.
+    hp_sharded = (dataclasses.replace(hp, lr=hp.lr * 2.0)
+                  if mutate == "optimizer-lr" else hp)
+    return hp_sharded, hp
+
+
+def _state_and_batch(cfg, b=8):
+    from cs336_systems_tpu.train import init_train_state
+
+    params, opt = init_train_state(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (b, cfg.context_length),
+                           0, cfg.vocab_size)
+    y = jnp.roll(x, -1, axis=-1)
+    return params, opt, x, y
+
+
+def _oracle(cfg, hp):
+    from cs336_systems_tpu.train import make_train_step
+
+    return make_train_step(cfg, hp, donate=False, capture_stages=True)
+
+
+def _run_single(cfg, mutate):
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    got = _oracle(cfg, hp_s)(params, opt, x, y)[3]
+    return ref, got
+
+
+def _run_dp(variant, mutate):
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel.dp import make_dp_train_step
+    from cs336_systems_tpu.parallel.mesh import make_mesh, shard_batch
+
+    cfg = _tiny_cfg()
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    mesh = make_mesh({"dp": 8})
+    step = make_dp_train_step(cfg, hp_s, mesh, variant=variant,
+                              donate=False, capture_stages=True)
+    xs, ys = shard_batch(mesh, x, y, axis="dp")
+    return ref, step(params, opt, xs, ys)[3]
+
+
+def _run_tp(mutate):
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.tp import make_tp_train_step
+
+    cfg = _tiny_cfg()
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    step = make_tp_train_step(cfg, hp_s, make_mesh({"dp": 2, "tp": 4}),
+                              donate=False, capture_stages=True)
+    return ref, step(params, opt, x, y)[3]
+
+
+def _run_tp_sp(mutate):
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.tp_sp import make_tp_sp_train_step
+
+    cfg = _tiny_cfg()
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    step = make_tp_sp_train_step(
+        cfg, hp_s, make_mesh({"dp": 2, "tp": 2, "sp": 2}),
+        donate=False, capture_stages=True)
+    return ref, step(params, opt, x, y)[3]
+
+
+def _run_sp(mutate):
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.sp import (
+        make_sp_train_step, shard_batch_sp)
+
+    cfg = _tiny_cfg()
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    step = make_sp_train_step(cfg, hp_s, mesh, donate=False,
+                              capture_stages=True)
+    xs, ys = shard_batch_sp(mesh, x, y)
+    return ref, step(params, opt, xs, ys)[3]
+
+
+def _run_ep_a2a(mutate):
+    from cs336_systems_tpu.analysis.registry import _moe_cfg
+    from cs336_systems_tpu.optim.adamw import adamw_init
+    from cs336_systems_tpu.parallel.ep import (
+        make_ep_train_step, shard_params_ep)
+    from cs336_systems_tpu.parallel.mesh import make_mesh, shard_batch
+
+    cfg = _moe_cfg()
+    hp_s, hp_o = _hp(mutate)
+    params, opt, x, y = _state_and_batch(cfg)
+    ref = _oracle(cfg, hp_o)(params, opt, x, y)[3]
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    p_ep = shard_params_ep(params, mesh, cfg)
+    o_ep = adamw_init(p_ep)
+    step = make_ep_train_step(cfg, hp_s, mesh, donate=False,
+                              capture_stages=True)
+    xs, ys = shard_batch(mesh, x, y, axis=("dp", "ep"))
+    return ref, step(p_ep, o_ep, xs, ys)[3]
+
+
+def _families() -> dict[str, Callable[[str | None], tuple]]:
+    from cs336_systems_tpu.analysis.registry import _moe_cfg, _tiny_cfg
+
+    return {
+        "train_single": lambda m: _run_single(_tiny_cfg(), m),
+        "train_single_bf16": lambda m: _run_single(
+            _tiny_cfg(compute_dtype="bfloat16"), m),
+        "train_moe_sorted": lambda m: _run_single(_moe_cfg(), m),
+        "train_moe_gmm": lambda m: _run_single(
+            _moe_cfg(moe_dispatch="gmm"), m),
+        "train_dp_naive": lambda m: _run_dp("naive", m),
+        "train_dp_bucketed": lambda m: _run_dp("bucketed", m),
+        "train_tp": _run_tp,
+        "train_tp_sp": _run_tp_sp,
+        "train_sp": _run_sp,
+        "train_ep_a2a": _run_ep_a2a,
+    }
+
+
+def family_names() -> tuple[str, ...]:
+    return tuple(_families())
+
+
+@contextlib.contextmanager
+def _mutation_ctx(mutate: str | None):
+    """Re-inject a known-bad gradient reduction while the step builds and
+    traces (the builders bind the sync functions at build/trace time, so
+    the patch must span both). ``drop-grad-sync`` resurrects the exact
+    historical defect; ``double-psum`` over-reduces an already-synced
+    gradient (×W scale). Families that own no explicit sync (the
+    single-device self-diffs, the GSPMD tp/tp_sp steps) are unaffected
+    by either — use ``optimizer-lr`` there."""
+    if mutate in (None, "optimizer-lr"):
+        yield
+        return
+    from cs336_systems_tpu.parallel import dp, ep
+
+    orig_sync, orig_ep_sync = dp.sync_grads, ep._sync_ep_grads
+    if mutate == "drop-grad-sync":
+        dp.sync_grads = lambda grads, *a, **k: grads
+        ep._sync_ep_grads = lambda grads, *a, **k: grads
+    elif mutate == "double-psum":
+        def double_dp(grads, axis="dp", *a, **k):
+            synced = orig_sync(grads, axis, *a, **k)
+            return jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, axis), synced)
+
+        def double_ep(grads, ep_mask, token_axes, *a, **k):
+            synced = orig_ep_sync(grads, ep_mask, token_axes, *a, **k)
+            return jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, token_axes), synced)
+
+        dp.sync_grads, ep._sync_ep_grads = double_dp, double_ep
+    else:
+        raise ValueError(
+            f"unknown mutation {mutate!r} (pick from {MUTATIONS})")
+    try:
+        yield
+    finally:
+        dp.sync_grads, ep._sync_ep_grads = orig_sync, orig_ep_sync
+
+
+def _to_host(stages: dict) -> dict:
+    return jax.tree_util.tree_map(np.asarray, stages)
+
+
+def run_family(name: str, mutate: str | None = None) -> dict:
+    """Build + run the family's sharded step and oracle on one global
+    batch; return the ``diff_stages`` report (plus family metadata)."""
+    fams = _families()
+    if name not in fams:
+        raise KeyError(
+            f"unknown training family {name!r} (pick from {list(fams)})")
+    if mutate is not None and mutate not in MUTATIONS:
+        raise ValueError(
+            f"unknown mutation {mutate!r} (pick from {MUTATIONS})")
+    with _mutation_ctx(mutate):
+        ref_stages, got_stages = fams[name](mutate)
+        report = diff_stages(_to_host(ref_stages), _to_host(got_stages))
+    report["family"] = name
+    report["mutation"] = mutate
+    report["backend"] = jax.default_backend()
+    report["n_devices"] = jax.device_count()
+    return report
+
+
+def main(argv=None) -> int:
+    from cs336_systems_tpu.analysis.gradsan_cli import main as cli_main
+
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

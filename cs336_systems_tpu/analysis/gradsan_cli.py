@@ -1,0 +1,101 @@
+"""CLI for the gradsan stage-level differential numerics sanitizer.
+
+    python -m cs336_systems_tpu.analysis.gradsan_cli --step train_ep_a2a
+    python -m cs336_systems_tpu.analysis.gradsan --step train_sp --json
+    python -m cs336_systems_tpu.analysis.gradsan --list
+    python -m cs336_systems_tpu.analysis.gradsan --step train_sp \
+        --mutate drop-grad-sync        # must exit 1 at (grads, <leaf>)
+
+Exit status: 0 every stage matches the single-device oracle, 1 a stage
+diverged (first (stage, leaf) on stderr-free stdout), 2 the family
+failed to build or run. Same gate semantics as analysis.lint /
+trace_cli --diff / mem_cli --diff — wire it into CI as-is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+
+
+def _fmt_report(rep: dict) -> str:
+    lines = [
+        f"gradsan {rep['family']}"
+        + (f" [mutate={rep['mutation']}]" if rep.get("mutation") else "")
+        + f": sharded vs single-device oracle, one global batch "
+          f"({rep['backend']}, {rep['n_devices']} devices)",
+        f"  {'stage':<14} {'tolerance':<11} {'max|d|':>10} "
+        f"{'max ulp':>9} {'bad/total':>13}  status",
+    ]
+    for s in rep["stages"]:
+        bad = f"{s['n_bad']}/{s['n_elements']}"
+        status = "ok" if s["clean"] else f"DIVERGED ({s['leaf'] or '<scalar>'})"
+        lines.append(
+            f"  {s['stage']:<14} {s['tolerance']:<11} {s['max_abs']:>10.3e} "
+            f"{s['max_ulp']:>9} {bad:>13}  {status}")
+    first = rep["first_divergence"]
+    if first is None:
+        lines.append("  clean: every stage within tolerance")
+    else:
+        lines.append(
+            f"  FIRST DIVERGENCE: stage={first['stage']} "
+            f"leaf={first['leaf'] or '<scalar>'} "
+            f"max|d|={first['max_abs']:.3e} max_ulp={first['max_ulp']} "
+            f"({first['n_bad']}/{first['n_elements']} elements outside "
+            f"{first['tolerance']} rtol={first['rtol']} atol={first['atol']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    from cs336_systems_tpu.analysis import gradsan
+
+    ap = argparse.ArgumentParser(
+        prog="gradsan",
+        description="stage-level differential numerics sanitizer: diff a "
+                    "sharded training family against the single-device "
+                    "oracle stage by stage")
+    ap.add_argument("--step", help="training family to check (see --list)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list families and mutations, then exit")
+    ap.add_argument("--mutate", choices=gradsan.MUTATIONS,
+                    help="re-inject a known defect to prove localization")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        if args.json:
+            print(json.dumps({"families": list(gradsan.family_names()),
+                              "mutations": list(gradsan.MUTATIONS)}))
+        else:
+            print("training families:")
+            for name in gradsan.family_names():
+                print(f"  {name}")
+            print("mutations (--mutate):")
+            for name in gradsan.MUTATIONS:
+                print(f"  {name}")
+        return 0
+
+    if not args.step:
+        ap.error("--step is required (or --list)")
+
+    try:
+        rep = gradsan.run_family(args.step, mutate=args.mutate)
+    except Exception as e:  # noqa: BLE001 — exit 2 is the build-error gate
+        if args.json:
+            print(json.dumps({"schema": "gradsan/v1", "family": args.step,
+                              "error": f"{type(e).__name__}: {e}"}))
+        else:
+            traceback.print_exc()
+            print(f"gradsan {args.step}: BUILD/RUN ERROR: "
+                  f"{type(e).__name__}: {e}")
+        return 2
+
+    print(json.dumps(rep) if args.json else _fmt_report(rep))
+    return 0 if rep["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

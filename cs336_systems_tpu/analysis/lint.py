@@ -61,6 +61,9 @@ def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
     if c.get("phase_scopes"):
         out += contracts.check_phase_scopes(name, traced.jaxpr,
                                             c["phase_scopes"])
+    if c.get("grad_reduction"):
+        out += contracts.check_grad_reduction(name, traced.jaxpr,
+                                              c["grad_reduction"])
     budget = registry.HBM_BUDGET_BYTES.get(name)
     if budget:
         out += contracts.check_hbm_budget(name, budget)

@@ -219,6 +219,21 @@ def _build_train_tp_sp() -> Traced:
     return _traced_train(step, state, x, y, contract)
 
 
+def _build_train_sp() -> Traced:
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.sp import lint_contract, make_sp_train_step
+
+    cfg = _tiny_cfg()
+    state = _abstract_state(cfg)
+    x, y = _batch(cfg)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    step = make_sp_train_step(cfg, _hp(), mesh)
+    contract = dict(lint_contract(state[0], cfg, mesh),
+                    min_aliases=_n_leaves(state),
+                    phase_scopes=TRAIN_PHASE_SCOPES)
+    return _traced_train(step, state, x, y, contract)
+
+
 def _build_train_ep_a2a() -> Traced:
     from cs336_systems_tpu.parallel.ep import lint_contract, make_ep_train_step
     from cs336_systems_tpu.parallel.mesh import make_mesh
@@ -338,6 +353,7 @@ STEPS: tuple[StepSpec, ...] = (
              functools.partial(_build_train_dp, "bucketed")),
     StepSpec("train_tp", _build_train_tp),
     StepSpec("train_tp_sp", _build_train_tp_sp),
+    StepSpec("train_sp", _build_train_sp),
     StepSpec("train_ep_a2a", _build_train_ep_a2a),
     StepSpec("gmm_fused_bwd", _build_gmm13_bwd),
     StepSpec("serve_dp", functools.partial(_build_serve, {"dp": 8}, "dp")),

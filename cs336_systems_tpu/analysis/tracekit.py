@@ -477,6 +477,27 @@ def _build_train_tp_sp() -> Runner:
     return _placed_train_runner(cfg, step, mesh, tp.param_specs(cfg))
 
 
+def _build_train_sp() -> Runner:
+    import jax
+
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.optim.adamw import adamw_init
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.sp import (
+        make_sp_train_step, shard_batch_sp)
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    step = make_sp_train_step(cfg, _hp(), mesh, donate=False)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    opt = adamw_init(params)
+    x, y = _concrete_batch(cfg, 8)
+    x, y = shard_batch_sp(mesh, x, y)
+    return Runner(step, (params, opt, x, y), 8 * cfg.context_length,
+                  model_flops_per_token(cfg), mesh.size)
+
+
 def _build_train_ep_a2a() -> Runner:
     from cs336_systems_tpu.analysis.registry import _moe_cfg
     from cs336_systems_tpu.parallel import ep
@@ -534,6 +555,7 @@ FAMILIES: dict[str, Callable[[], Runner]] = {
     "train_dp_bucketed": lambda: _build_train_dp("bucketed"),
     "train_tp": _build_train_tp,
     "train_tp_sp": _build_train_tp_sp,
+    "train_sp": _build_train_sp,
     "train_ep_a2a": _build_train_ep_a2a,
     "serve_dp": lambda: _build_serve({"dp": 8}, "dp"),
     "serve_tp": lambda: _build_serve({"tp": 4}, None, "tp"),

@@ -125,9 +125,18 @@ def sync_grads(
     ``trainable``: optional boolean mask pytree; masked-out leaves are
     excluded from communication entirely (parity: frozen params never
     registered for reduction) and returned as zeros.
+
+    The whole reduction runs under an ``annotate("grad_sync")`` scope: the
+    ``grad-reduction`` lint rule (analysis/contracts.py) identifies the
+    gradient psums by that scope and checks each family issues them
+    exactly once with mean normalization — the rule that pins the
+    "gradients inside shard_map are LOCAL under this jax's forced
+    check_rep=False" fact (_compat.py) as a contract.
     """
     if variant not in VARIANTS:
         raise ValueError(f"unknown DP variant {variant!r}; pick from {VARIANTS}")
+
+    from cs336_systems_tpu.utils.profiling import annotate
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if trainable is not None:
@@ -146,31 +155,35 @@ def sync_grads(
         ]
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    if variant == "naive":
-        return put_back({i: jax.lax.pmean(leaves[i], axis) for i in active})
+    with annotate("grad_sync"):
+        if variant == "naive":
+            return put_back({i: jax.lax.pmean(leaves[i], axis) for i in active})
 
-    groups = collective_groups(leaves, variant, bucket_size_mb, active)
+        groups = collective_groups(leaves, variant, bucket_size_mb, active)
 
-    synced: dict = {}
-    for group in groups:
-        flat = jnp.concatenate([leaves[i].ravel() for i in group])
-        flat = jax.lax.pmean(flat, axis)
-        offset = 0
-        for i in group:
-            n = leaves[i].size
-            synced[i] = flat[offset : offset + n].reshape(leaves[i].shape)
-            offset += n
+        synced: dict = {}
+        for group in groups:
+            flat = jnp.concatenate([leaves[i].ravel() for i in group])
+            flat = jax.lax.pmean(flat, axis)
+            offset = 0
+            for i in group:
+                n = leaves[i].size
+                synced[i] = flat[offset : offset + n].reshape(leaves[i].shape)
+                offset += n
     return put_back(synced)
 
 
 def lint_contract(params, variant: str = "bucketed",
-                  bucket_size_mb: float = 1000.0) -> dict:
+                  bucket_size_mb: float = 1000.0, axis: str = "dp") -> dict:
     """Declared collective contract of ``make_dp_train_step`` for the
     static analysis linter (analysis/registry.py) — derived from the SAME
     ``collective_groups`` the step issues from, so the expected count and
     the issued count cannot drift independently: ``psum`` = one fused
     pmean per gradient group + the loss pmean. Everything else is zero —
-    a dp train step that grows an all_gather or all_to_all is a bug."""
+    a dp train step that grows an all_gather or all_to_all is a bug.
+    ``grad_reduction``: the grad pmeans, scoped ``grad_sync``, reduced
+    over ``axis`` exactly once with mean normalization
+    (contracts.check_grad_reduction)."""
     leaves = jax.tree_util.tree_leaves(params)
     if variant == "naive":
         n_groups = len(leaves)
@@ -178,6 +191,7 @@ def lint_contract(params, variant: str = "bucketed",
         n_groups = len(collective_groups(leaves, variant, bucket_size_mb))
     return {
         "collectives": {"psum": n_groups + 1},
+        "grad_reduction": {"axes": (axis,), "count": n_groups},
         "note": f"dp[{variant}]: one grad pmean per group ({n_groups}) "
                 "+ the loss pmean",
     }
@@ -193,6 +207,7 @@ def make_dp_train_step(
     bucket_size_mb: float = 1000.0,
     axis: str = "dp",
     donate: bool = True,
+    capture_stages: bool = False,
 ) -> Callable:
     """Jitted DP LM train step over ``mesh[axis]``.
 
@@ -232,15 +247,20 @@ def make_dp_train_step(
         return jax.lax.pmean(loss, axis), grads
 
     local_step = make_update_fn(
-        None, hp, clip_norm, lr_schedule, value_and_grad=synced_vag
+        None, hp, clip_norm, lr_schedule, value_and_grad=synced_vag,
+        capture_stages=capture_stages,
     )
 
+    out_specs = (P(), P(), P())
+    if capture_stages:
+        out_specs = out_specs + (P(),)  # stages: every leaf replicated
     step = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis)),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
     )
+    donate = donate and not capture_stages
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 

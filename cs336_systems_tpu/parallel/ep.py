@@ -32,10 +32,30 @@ Two step builders (``make_ep_train_step`` variant=):
   dispatch's O(T·E·C·D) compute.
 
 AdamW moments shard exactly like their parameters, so expert optimizer
-state is also 1/ep per device. Gradient clipping under "a2a" reduces the
-global norm correctly across shards: expert-leaf square-norms psum over
-``ep`` (dense leaves are replicated), then the shared clip formula
-applies (ops/nn.clip_gradients with an external norm).
+state is also 1/ep per device.
+
+Gradient reduction under "a2a" (the fix behind the former a2a/sp parity
+pins): the in-body ``value_and_grad`` yields LOCAL gradients — this
+jax's shard_map is forced to ``check_rep=False`` (_compat.py), so
+replicated-operand grads are NOT auto-psummed, and the loss pmean's 1/W
+cancels against its own psum transpose. Concretely, device (i, j) on a
+(dp × ep) mesh holds, pre-sync:
+
+- dense leaves: its shard's raw contribution ``∂ℓ_(i,j)/∂p`` — the true
+  gradient is their pmean over BOTH token axes;
+- expert leaves (sharded over ep): ``Σ_k ∂ℓ_(i,k)/∂e_j`` — the a2a
+  transpose already sums the ep direction's contributions into the
+  owning shard, so the true gradient is the pmean over ``dp`` of the
+  local value divided by the ep degree (just the 1/ep scale when there
+  is no dp axis).
+
+``_sync_ep_grads`` issues exactly that, flat-concatenated per dtype
+group under the ``grad_sync`` scope (the ``grad-reduction`` lint rule
+reads the scope; ``analysis/gradsan`` diffs the stage values). Gradient
+clipping then reduces the global norm correctly across shards:
+expert-leaf square-norms psum over ``ep`` (dense leaves are replicated
+post-sync), then the shared clip formula applies
+(ops/nn.clip_gradients with an external norm).
 """
 
 from __future__ import annotations
@@ -150,6 +170,46 @@ def _ep_grad_norm(grads, ep_mask, ep_axis: str):
     return jnp.sqrt(dense_sq + jax.lax.psum(exp_sq, ep_axis))
 
 
+def _sync_ep_grads(grads, ep_mask, token_axes, ep_axis: str, ep_degree: int):
+    """Reduce LOCAL a2a-step gradients to the true global gradient (module
+    docstring derivation): dense leaves pmean over every token axis;
+    expert leaves pmean over the dp axes (their ep direction was already
+    summed by the a2a transpose) scaled by 1/ep_degree. One concatenated
+    collective per dtype group per class (the dp "flat" granularity,
+    via the same ``collective_groups``), under the ``grad_sync`` scope
+    the grad-reduction lint rule keys on. When there is no dp axis the
+    expert leaves take only the 1/ep scale — zero collectives."""
+    import jax.numpy as jnp
+
+    from cs336_systems_tpu.parallel.dp import collective_groups
+    from cs336_systems_tpu.utils.profiling import annotate
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    mask = treedef.flatten_up_to(ep_mask)
+    dp_axes = tuple(a for a in token_axes if a != ep_axis)
+    out = list(leaves)
+
+    def reduce_class(idxs, axes, scale):
+        for group in collective_groups(leaves, "flat", 0.0, idxs):
+            flat = jnp.concatenate([leaves[i].ravel() for i in group])
+            if axes:
+                flat = jax.lax.pmean(flat, axes)
+            if scale is not None:
+                flat = flat * scale
+            offset = 0
+            for i in group:
+                n = leaves[i].size
+                out[i] = flat[offset:offset + n].reshape(leaves[i].shape)
+                offset += n
+
+    with annotate("grad_sync"):
+        reduce_class([i for i, m in enumerate(mask) if not m], token_axes,
+                     None)
+        reduce_class([i for i, m in enumerate(mask) if m], dp_axes,
+                     1.0 / ep_degree)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def lint_contract(cfg: TransformerConfig, n_token_axes: int = 2) -> dict:
     """Declared contract of ``make_ep_train_step(variant="a2a")`` for the
     static analysis linter, per unrolled MoE layer (L = num_layers,
@@ -162,26 +222,36 @@ def lint_contract(cfg: TransformerConfig, n_token_axes: int = 2) -> dict:
     - ``all_gather`` = k·A·L: routing gathers the [W, E] claim counts
       once per priority per token axis (models/moe._gather_counts walks
       the axes in order so the global fill order is well-defined).
-    - ``psum`` = 3L + 3: per layer, the aux-loss pmean pair and the
-      count reduction the global capacity derives from; step-level, the
-      loss pmean, its transpose in the backward, and the expert-shard
-      grad-norm psum (``_ep_grad_norm``).
+    - ``psum`` = 3L + 4 + (A − 1): per layer, the aux-loss pmean pair and
+      the count reduction the global capacity derives from; step-level,
+      the loss pmean, its transpose in the backward, the expert-shard
+      grad-norm psum (``_ep_grad_norm``), the dense grad-sync pmean, and
+      — only when a dp axis exists (A = 2) — the expert grad-sync pmean
+      over dp (``_sync_ep_grads``; every param leaf is stored fp32, so
+      each class is ONE dtype group → one flat collective).
     - barriers ≥ 2L: the per-layer ``optimization_barrier`` (forward +
       its companion in the backward) that pins the per-layer weight
       casts (transformer.py — 47.9 ms/step when absent).
+    - ``grad_reduction``: the grad-sync pmeans above, scoped
+      ``grad_sync``, each with mean normalization.
 
     The ``"dense"`` variant is GSPMD (zero jaxpr collectives, like tp).
     """
     L = cfg.num_layers
+    have_dp = n_token_axes == 2
+    token_axes = ("dp", "ep") if have_dp else ("ep",)
+    n_sync = 2 if have_dp else 1
     return {
         "collectives": {
             "all_to_all": 5 * L,
             "all_gather": cfg.moe_top_k * n_token_axes * L,
-            "psum": 3 * L + 3,
+            "psum": 3 * L + 3 + n_sync,
         },
         "barriers": 2 * L if not cfg.scan_layers else 0,
-        "note": "ep[a2a]: 5 a2a + k·axes gathers per MoE layer; "
-                "3 psums per layer + 3 step-level",
+        "grad_reduction": {"axes": token_axes, "count": n_sync},
+        "note": "ep[a2a]: 5 a2a + k·axes gathers per MoE layer; 3 psums "
+                f"per layer + {3 + n_sync} step-level (loss pmean + its "
+                "transpose + grad-norm + grad-sync)",
     }
 
 
@@ -195,6 +265,7 @@ def make_ep_train_step(
     ep_axis: str = "ep",
     donate: bool = True,
     variant: str = "a2a",
+    capture_stages: bool = False,
 ) -> Callable:
     """Jitted (dp ×) ep MoE train step: expert params/moments sharded over
     ``ep_axis``, batch sharded over the token axes.
@@ -203,6 +274,10 @@ def make_ep_train_step(
     a shard_map — tokens shard over (dp × ep), the fast sorted machinery
     runs locally per expert shard (module docstring). ``variant="dense"``:
     the GSPMD-annotated dense-dispatch step (rounds ≤4, kept for A/B).
+
+    ``capture_stages`` (a2a only) appends the stage dict as a fourth
+    output (train.make_update_fn) with grad-tree stages laid out like the
+    params — the analysis/gradsan seam; forces ``donate`` off.
     """
     import dataclasses
 
@@ -239,27 +314,49 @@ def make_ep_train_step(
         ospecs = opt_state_specs(cfg, ep_axis)
         ep_mask = ep_sharded_mask(cfg, ep_axis)
 
+        ep_degree = mesh.shape[ep_axis]
+
         def sharded_loss(p, x, y):
             return jax.lax.pmean(lm_loss(p, x, y, cfg=ecfg), token_axes)
 
         def vag(p, x, y):
+            # in-body grads are LOCAL under forced check_rep=False
+            # (module docstring) — sync BEFORE the norm/clip or every
+            # shard clips and optimizes against a different gradient
             loss, grads = jax.value_and_grad(sharded_loss)(p, x, y)
-            if clip_norm is not None:
-                grads = clip_gradients(
-                    grads, clip_norm, norm=_ep_grad_norm(grads, ep_mask, ep_axis)
-                )
-            return loss, grads
+            grads = _sync_ep_grads(grads, ep_mask, token_axes, ep_axis,
+                                   ep_degree)
+            if clip_norm is None and not capture_stages:
+                return loss, grads
+            norm = _ep_grad_norm(grads, ep_mask, ep_axis)
+            clipped = (clip_gradients(grads, clip_norm, norm=norm)
+                       if clip_norm is not None else grads)
+            if capture_stages:
+                # canonical stage values make_update_fn cannot compute
+                # itself: its generic global_grad_norm lacks the
+                # expert-shard psum
+                return loss, clipped, {"grads": grads, "grad_norm": norm,
+                                       "clipped_grads": clipped}
+            return loss, clipped
 
         local_step = make_update_fn(
             None, hp, clip_norm=None, lr_schedule=lr_schedule,
-            value_and_grad=vag,
+            value_and_grad=vag, capture_stages=capture_stages,
         )
+        out_specs = (pspecs, ospecs, P())
+        if capture_stages:
+            out_specs = out_specs + ({
+                "loss": P(), "grads": pspecs, "grad_norm": P(),
+                "clipped_grads": pspecs, "adamw_delta": pspecs,
+                "new_m": pspecs, "new_v": pspecs,
+            },)
         step = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(pspecs, ospecs, batch_spec, batch_spec),
-            out_specs=(pspecs, ospecs, P()),
+            out_specs=out_specs,
         )
+        donate = donate and not capture_stages
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
     pspecs = param_specs(cfg, ep_axis)
     ospecs = opt_state_specs(cfg, ep_axis)

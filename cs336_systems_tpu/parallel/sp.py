@@ -10,9 +10,18 @@ Layout inside the jitted ``shard_map``:
 - params, optimizer state: replicated;
 - x, y: [batch/dp, S/sp] per device;
 - RoPE positions: global offsets, computed from the device's sp index;
-- loss: token-mean over the device shard, then ``pmean`` over the mesh —
-  differentiation through the pmean yields correctly-scaled replicated
-  gradients (the backward's psum rides the same ICI ring).
+- loss: token-mean over the device shard, then ``pmean`` over the mesh;
+- gradients: the in-body ``value_and_grad`` yields LOCAL per-device
+  gradients (this jax's shard_map is forced to ``check_rep=False`` —
+  _compat.py — so replicated-operand gradients are NOT auto-psummed; the
+  pmean's 1/W is cancelled by its own psum transpose, leaving the raw
+  per-shard contribution ∂ℓ_w/∂p), so the step owns the reduction: one
+  flat ``pmean`` over (dp × sp) via ``parallel/dp.sync_grads`` under the
+  ``grad_sync`` scope. Skipping it was the a2a/sp parity regression —
+  every device ran AdamW on its local gradient (~40% first-step sign
+  flips bounded by 2·lr) while the forward loss still matched.
+  ``analysis/gradsan`` localizes this class of defect to the (stage,
+  leaf) and the ``grad-reduction`` lint rule pins the reduction count.
 """
 
 from __future__ import annotations
@@ -43,9 +52,13 @@ def make_sp_train_step(
     dp_axis: str | None = "dp",
     sp_axis: str = "sp",
     donate: bool = True,
+    capture_stages: bool = False,
 ) -> Callable:
     """Jitted (dp ×) sp train step: ``(params, opt_state, x, y) ->
-    (params, opt_state, loss)`` with x/y sharded [dp_axis, sp_axis]."""
+    (params, opt_state, loss)`` with x/y sharded [dp_axis, sp_axis].
+
+    ``capture_stages`` appends the replicated stage dict as a fourth
+    output (train.make_update_fn) — the analysis/gradsan seam."""
     rcfg = ring_config(cfg, sp_axis)
     axes = tuple(a for a in (dp_axis, sp_axis) if a and a in mesh.shape)
     if sp_axis not in mesh.shape:
@@ -59,6 +72,7 @@ def make_sp_train_step(
         )
     batch_spec = P(dp_axis if dp_axis in mesh.shape else None, sp_axis)
 
+    from cs336_systems_tpu.parallel.dp import sync_grads
     from cs336_systems_tpu.train import make_update_fn
 
     sp_degree = mesh.shape[sp_axis]
@@ -79,15 +93,67 @@ def make_sp_train_step(
         logits = transformer_lm(p, x, rcfg, positions=positions)
         return jax.lax.pmean(cross_entropy(logits, y), axes)
 
-    local_step = make_update_fn(sharded_loss, hp, clip_norm, lr_schedule)
+    def synced_vag(p, x, y):
+        # In-body grads are LOCAL (module docstring): average them over
+        # every token axis before clip/AdamW or each device optimizes
+        # against its own shard's gradient and the replicated params
+        # silently fork (out_specs P() then returns device 0's copy).
+        loss, grads = jax.value_and_grad(sharded_loss)(p, x, y)
+        return loss, sync_grads(grads, axes, variant="flat")
 
+    local_step = make_update_fn(
+        None, hp, clip_norm, lr_schedule, value_and_grad=synced_vag,
+        capture_stages=capture_stages,
+    )
+
+    out_specs = (P(), P(), P())
+    if capture_stages:
+        out_specs = out_specs + (P(),)  # stages: every leaf replicated
     step = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(), P(), batch_spec, batch_spec),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
     )
+    donate = donate and not capture_stages
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def lint_contract(params, cfg: TransformerConfig, mesh: Mesh,
+                  dp_axis: str | None = "dp", sp_axis: str = "sp") -> dict:
+    """Declared contract of ``make_sp_train_step`` for the static linter.
+
+    - ``psum`` = n_grad_groups + 2: the forward loss pmean, its psum
+      transpose in the backward (the scalar cotangent — check_rep=False
+      transposes psum to psum), and one flat grad pmean per dtype group
+      (``dp.collective_groups`` with variant="flat" — derived from the
+      same grouping the step issues from).
+    - ``ppermute`` = layer_sites · 2 directions(K,V) · (sp−1 hops) · 2
+      (forward + its ppermute transpose in the backward): the ring
+      attention's Python-unrolled hop loop (parallel/ring.py). These are
+      static call SITES — with ``scan_layers=True`` the whole stack is
+      one ``lax.scan`` body, so layer_sites = 1 regardless of depth
+      (verified against the trace: the 2-layer scanned registry family
+      issues 12 = 1·2·3·2, split 6 forward-perm + 6 inverse-perm);
+      an unrolled stack multiplies by ``num_layers``.
+    - ``grad_reduction``: the flat grad pmeans, scoped ``grad_sync``,
+      reduced over (dp × sp) exactly once with mean normalization.
+    """
+    from cs336_systems_tpu.parallel.dp import collective_groups
+
+    axes = tuple(a for a in (dp_axis, sp_axis) if a and a in mesh.shape)
+    leaves = jax.tree_util.tree_leaves(params)
+    n_groups = len(collective_groups(leaves, "flat", 0.0))
+    sp = mesh.shape[sp_axis]
+    layer_sites = 1 if cfg.scan_layers else cfg.num_layers
+    ppermute = layer_sites * 2 * (sp - 1) * 2
+    return {
+        "collectives": {"psum": n_groups + 2, "ppermute": ppermute},
+        "grad_reduction": {"axes": axes, "count": n_groups},
+        "note": f"sp: loss pmean + bwd transpose + {n_groups} flat grad "
+                f"pmean(s); ring = 2·(sp-1) ppermutes per layer-site "
+                "per pass (scan body counts once)",
+    }
 
 
 def shard_batch_sp(mesh: Mesh, *arrays, dp_axis: str | None = "dp",

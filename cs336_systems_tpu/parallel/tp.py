@@ -113,6 +113,7 @@ def make_tp_train_step(
     dp_axis: str | None = "dp",
     tp_axis: str = "tp",
     donate: bool = True,
+    capture_stages: bool = False,
 ) -> Callable:
     """Jitted (dp ×) tp LM train step: params/moments sharded over
     ``tp_axis``, batch sharded over ``dp_axis`` (if the mesh has one).
@@ -121,6 +122,10 @@ def make_tp_train_step(
     GSPMD-inserted: the jitted step is a single XLA program in which the
     backward's gradient collectives overlap with remaining compute — the
     property the reference builds by hand with async NCCL hooks.
+
+    ``capture_stages`` appends the stage dict as a fourth output with
+    grad-tree stages in the param layout (train.make_update_fn) — the
+    analysis/gradsan seam; forces ``donate`` off.
     """
     import dataclasses
     import functools
@@ -154,15 +159,30 @@ def make_tp_train_step(
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=cfg, mesh=mesh), hp, clip_norm,
-        lr_schedule,
+        lr_schedule, capture_stages=capture_stages,
     )
 
+    out_shardings = (sh(pspecs), sh(ospecs), sh(P()))
+    if capture_stages:
+        out_shardings = out_shardings + (stage_shardings(sh, pspecs),)
+    donate = donate and not capture_stages
     return jax.jit(
         step,
         in_shardings=(sh(pspecs), sh(ospecs), sh(bspec), sh(bspec)),
-        out_shardings=(sh(pspecs), sh(ospecs), sh(P())),
+        out_shardings=out_shardings,
         donate_argnums=(0, 1) if donate else (),
     )
+
+
+def stage_shardings(sh, pspecs):
+    """Sharding tree for the ``capture_stages`` dict of a GSPMD step:
+    grad-shaped stages follow the param layout, scalars replicate.
+    Shared by the tp and tp_sp builders (parallel/tp_sp.py)."""
+    return {
+        "loss": sh(P()), "grads": sh(pspecs), "grad_norm": sh(P()),
+        "clipped_grads": sh(pspecs), "adamw_delta": sh(pspecs),
+        "new_m": sh(pspecs), "new_v": sh(pspecs),
+    }
 
 
 def tp_param_bytes_per_device(params, mesh: Mesh, cfg: TransformerConfig,

@@ -88,6 +88,7 @@ def make_tp_sp_train_step(
     tp_axis: str = "tp",
     sp_axis: str = "sp",
     donate: bool = True,
+    capture_stages: bool = False,
 ) -> Callable:
     """Jitted (dp ×) tp × sp train step: ``(params, opt, x, y) ->
     (params, opt, loss)`` with params head/ff/vocab-sharded over
@@ -119,11 +120,17 @@ def make_tp_sp_train_step(
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=rcfg, mesh=mesh), hp, clip_norm,
-        lr_schedule,
+        lr_schedule, capture_stages=capture_stages,
     )
+    out_shardings = (sh(pspecs), sh(ospecs), sh(P()))
+    if capture_stages:
+        from cs336_systems_tpu.parallel.tp import stage_shardings
+
+        out_shardings = out_shardings + (stage_shardings(sh, pspecs),)
+    donate = donate and not capture_stages
     return jax.jit(
         step,
         in_shardings=(sh(pspecs), sh(ospecs), sh(bspec), sh(bspec)),
-        out_shardings=(sh(pspecs), sh(ospecs), sh(P())),
+        out_shardings=out_shardings,
         donate_argnums=(0, 1) if donate else (),
     )

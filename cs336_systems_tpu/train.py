@@ -80,6 +80,7 @@ def make_update_fn(
     value_and_grad: Callable | None = None,
     accum_steps: int = 1,
     metrics: bool = False,
+    capture_stages: bool = False,
 ) -> Callable:
     """The one canonical step body: ``(params, opt_state, x, y) ->
     (params, opt_state, loss)``.
@@ -108,6 +109,19 @@ def make_update_fn(
     ``--telemetry`` heartbeat. Off by default so the three-tuple contract
     every parallelism wrapper unpacks stays unchanged.
 
+    ``capture_stages``: the update instead returns a fourth element
+    ``stages`` — the canonical intermediate values every variant shares,
+    in pipeline order: ``loss``, ``grads`` (post-sync, pre-clip),
+    ``grad_norm`` (pre-clip global L2), ``clipped_grads``,
+    ``adamw_delta`` (fp32 new−old params), ``new_m``/``new_v`` (the
+    fresh moments). This is the seam ``analysis/gradsan`` diffs a
+    sharded step against the single-device oracle through, stage by
+    stage, to localize a numerics defect to the FIRST divergent (stage,
+    leaf). A custom ``value_and_grad`` may return a third element — a
+    dict overriding stage entries — when the canonical values are
+    computed inside it (ep's a2a clip, whose global norm needs the
+    expert-shard psum the generic ``global_grad_norm`` lacks).
+
     Phase annotation: the clip + schedule + AdamW tail runs under an
     ``annotate("optimizer")`` scope. Together with the model's own scopes
     (transformer.py: attn/ffn/…) and the ``transpose(...)`` markers AD
@@ -120,6 +134,9 @@ def make_update_fn(
             "pass either value_and_grad or accum_steps, not both — wrap the "
             "custom value_and_grad in your own accumulation instead"
         )
+    if metrics and capture_stages:
+        raise ValueError("metrics and capture_stages both append a fourth "
+                         "output — pick one")
     if value_and_grad is None:
         if accum_steps > 1:
             value_and_grad = make_accum_value_and_grad(loss_fn, accum_steps)
@@ -127,17 +144,36 @@ def make_update_fn(
             value_and_grad = jax.value_and_grad(loss_fn)
 
     def update(params, opt_state, x, y):
-        loss, grads = value_and_grad(params, x, y)
+        out = value_and_grad(params, x, y)
+        loss, grads = out[0], out[1]
+        stage_overrides = out[2] if len(out) > 2 else {}
         with annotate("optimizer"):
-            gnorm = global_grad_norm(grads) if (metrics or clip_norm is not None) \
-                else None
+            need_norm = (metrics or clip_norm is not None
+                         or (capture_stages and "grad_norm" not in stage_overrides))
+            gnorm = global_grad_norm(grads) if need_norm else None
+            raw_grads = grads
             if clip_norm is not None:
                 grads = clip_gradients(grads, clip_norm, norm=gnorm)
             lr = lr_schedule(opt_state["t"]) if lr_schedule is not None else None
-            params, opt_state = adamw_update(params, grads, opt_state, hp, lr=lr)
+            new_params, new_opt = adamw_update(params, grads, opt_state, hp,
+                                               lr=lr)
+        if capture_stages:
+            stages = {
+                "loss": loss,
+                "grads": raw_grads,
+                "grad_norm": gnorm,
+                "clipped_grads": grads,
+                "adamw_delta": jax.tree_util.tree_map(
+                    lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                    new_params, params),
+                "new_m": new_opt["m"],
+                "new_v": new_opt["v"],
+            }
+            stages.update(stage_overrides)
+            return new_params, new_opt, loss, stages
         if metrics:
-            return params, opt_state, loss, {"grad_norm": gnorm}
-        return params, opt_state, loss
+            return new_params, new_opt, loss, {"grad_norm": gnorm}
+        return new_params, new_opt, loss
 
     return update
 
@@ -150,6 +186,7 @@ def make_train_step(
     donate: bool = True,
     accum_steps: int = 1,
     metrics: bool = False,
+    capture_stages: bool = False,
 ) -> Callable:
     """Build a jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)``.
 
@@ -159,12 +196,16 @@ def make_train_step(
     and applies one optimizer step on the microbatch-averaged gradient.
     ``metrics`` appends ``{"grad_norm": ...}`` as a fourth output (see
     ``make_update_fn``) — the train_cli ``--telemetry`` path.
+    ``capture_stages`` appends the stage dict instead (``make_update_fn``)
+    and forces ``donate`` off — analysis/gradsan re-reads the inputs.
     """
 
     update = make_update_fn(
         functools.partial(lm_loss, cfg=cfg), hp, clip_norm, lr_schedule,
         accum_steps=accum_steps, metrics=metrics,
+        capture_stages=capture_stages,
     )
+    donate = donate and not capture_stages
     return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 
 

@@ -67,6 +67,24 @@ if [ "$paged_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$paged_status
 
+# gradsan gate: the differential numerics sanitizer on the two composed
+# families whose parity regression it root-caused (the a2a grad sync and
+# the sp/dp flat sync — parallel/ep.py, parallel/sp.py): the sharded
+# step must match the single-device oracle at every stage (exit 0); any
+# future reduction defect exits 1 naming the first divergent
+# (stage, leaf).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.gradsan --step train_ep_a2a --json \
+    > /tmp/gradsan_ep.json
+gradsan_status=$?
+if [ "$gradsan_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.gradsan --step train_tp_sp --json \
+        > /tmp/gradsan_tp_sp.json
+    gradsan_status=$?
+fi
+[ "$status" -eq 0 ] && status=$gradsan_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -352,3 +352,136 @@ def test_hbm_budget_rule_survives_analysis_failure():
     vs = contracts.check_hbm_budget("not_a_registered_family", 1 << 30)
     assert _rules(vs) == {"hbm-budget"}
     assert "failed to analyze" in vs[0].message
+
+
+# --- grad-reduction ---------------------------------------------------------
+#
+# Mutation discipline for the rule that pins the a2a/sp parity root cause
+# (gradients inside shard_map are LOCAL under this jax's forced
+# check_rep=False — parallel/sp.py, parallel/ep.py): each known-bad
+# gradient-reduction shape must fire, the correct shape must pass.
+
+
+def _grad_sync_jaxpr(body, mesh_axes=None):
+    from jax.sharding import PartitionSpec as P
+
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(mesh_axes or {"dp": 8})
+    sm = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    return jax.make_jaxpr(sm)(jax.ShapeDtypeStruct((8, 4), jnp.float32))
+
+
+_GR_CONTRACT = {"axes": ("dp",), "count": 1}
+
+
+def test_grad_reduction_clean_and_missing():
+    from cs336_systems_tpu.utils.profiling import annotate
+
+    def good(g):
+        with annotate("grad_sync"):
+            return jax.lax.pmean(g, "dp")  # psum + div: mean-normalized
+
+    assert contracts.check_grad_reduction(
+        "t", _grad_sync_jaxpr(good), _GR_CONTRACT) == []
+
+    def missing(g):
+        return g  # the historical defect: each device keeps its local grad
+
+    vs = contracts.check_grad_reduction(
+        "t", _grad_sync_jaxpr(missing), _GR_CONTRACT)
+    assert _rules(vs) == {"grad-reduction"}
+    assert "missing their reduction" in vs[0].message
+    assert "LOCAL" in vs[0].message
+
+
+def test_grad_reduction_double_psum_flagged():
+    from cs336_systems_tpu.utils.profiling import annotate
+
+    def double(g):
+        with annotate("grad_sync"):
+            return jax.lax.psum(jax.lax.pmean(g, "dp"), "dp")
+
+    vs = contracts.check_grad_reduction(
+        "t", _grad_sync_jaxpr(double), _GR_CONTRACT)
+    assert "grad-reduction" in _rules(vs)
+    assert any("MORE than once" in v.message for v in vs)
+
+
+def test_grad_reduction_sum_without_mean_flagged():
+    from cs336_systems_tpu.utils.profiling import annotate
+
+    def summed(g):
+        with annotate("grad_sync"):
+            return jax.lax.psum(g, "dp")  # right count, W x scale
+
+    vs = contracts.check_grad_reduction(
+        "t", _grad_sync_jaxpr(summed), _GR_CONTRACT)
+    assert _rules(vs) == {"grad-reduction"}
+    assert "no div/mul consumer" in vs[0].message
+
+
+def test_grad_reduction_wrong_axis_flagged():
+    from cs336_systems_tpu.utils.profiling import annotate
+
+    def wrong_axis(g):
+        with annotate("grad_sync"):
+            return jax.lax.pmean(g, ("dp", "tp"))
+
+    jaxpr = _grad_sync_jaxpr(wrong_axis, {"dp": 4, "tp": 2})
+    vs = contracts.check_grad_reduction("t", jaxpr, _GR_CONTRACT)
+    assert "grad-reduction" in _rules(vs)
+    assert any("non-data axis" in v.message for v in vs)
+
+
+def test_grad_reduction_dropped_sync_in_real_dp_step_flagged(monkeypatch):
+    """End-to-end: strip dp.sync_grads from the registered dp family (the
+    exact sp/ep-a2a defect shape) and BOTH the grad-reduction rule and the
+    collective contract must fire on the same build that passes intact."""
+    from cs336_systems_tpu.parallel import dp
+
+    monkeypatch.setattr(dp, "sync_grads", lambda grads, *a, **k: grads)
+    spec = next(s for s in registry.STEPS if s.name == "train_dp_bucketed")
+    vs = lint_step("train_dp_bucketed", spec.build())
+    assert "grad-reduction" in _rules(vs)
+    assert any("gradsan" in v.message for v in vs
+               if v.rule == "grad-reduction")
+
+
+def test_explicit_sync_families_declare_grad_reduction():
+    """Every explicit-sync training family's contract carries the
+    grad_reduction key (GSPMD families are exempt — XLA owns their
+    reduction), so the rule cannot silently rot out of the registry."""
+    from cs336_systems_tpu.parallel import dp, ep, sp
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+
+    params = {"w": jnp.zeros((4, 4))}
+    assert "grad_reduction" in dp.lint_contract(params)
+    assert "grad_reduction" in ep.lint_contract(registry._moe_cfg())
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    assert "grad_reduction" in sp.lint_contract(
+        params, registry._tiny_cfg(), mesh)
+
+
+# --- exit codes -------------------------------------------------------------
+
+
+def test_lint_build_error_exits_2(monkeypatch, capsys):
+    """A registered step that fails to build must drive exit status 2 (a
+    broken registration is a finding, distinct from contract violations'
+    exit 1) — the run_tests_and_package.sh gate relies on this."""
+    import json as json_mod
+
+    from cs336_systems_tpu.analysis import lint as lint_mod
+
+    def boom():
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(registry, "STEPS",
+                        (registry.StepSpec("boom_step", boom),))
+    rc = lint_mod.main(["--only", "boom", "--json"])
+    assert rc == 2
+    rep = json_mod.loads(capsys.readouterr().out)
+    assert not rep["clean"]
+    assert rep["violations"][0]["rule"] == "build-error"
+    assert "kaboom" in rep["violations"][0]["message"]

@@ -1,0 +1,107 @@
+"""gradsan oracle tests: the sanitizer must (a) report the current tree
+clean on its families and (b) LOCALIZE each seeded defect at the exact
+(stage, leaf) where it enters the pipeline, with every upstream stage
+still clean — the property that makes the tool a bisector rather than a
+pass/fail bit.
+
+Same mutation discipline as tests/test_analysis.py: each --mutate seam
+re-injects a known defect class — the dropped grad sync that WAS the
+a2a/sp parity regression (diverges at ``grads`` while ``loss`` matches),
+a double reduction (also ``grads``), and a sharded-side optimizer skew
+(every gradient stage clean, first divergence at ``adamw_delta``).
+"""
+
+import json
+
+import pytest
+
+from cs336_systems_tpu.analysis import gradsan
+from cs336_systems_tpu.analysis.gradsan_cli import main as cli_main
+
+GRAD_STAGE_NAMES = list(gradsan.GRAD_STAGES)
+
+
+def _stage(rep, name):
+    return next(s for s in rep["stages"] if s["stage"] == name)
+
+
+def test_clean_self_diff_exits_0(capsys):
+    rc = cli_main(["--step", "train_single", "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["clean"] and rep["first_divergence"] is None
+    # self-diff of an identical program is bit-equal, not merely close
+    assert all(s["max_ulp"] == 0 for s in rep["stages"])
+
+
+def test_dropped_grad_sync_localizes_at_grads():
+    """The historical defect: local per-device gradients. The forward
+    loss matches (its pmean is separate), so the first divergence must
+    land exactly at the ``grads`` stage with a concrete leaf name."""
+    rep = gradsan.run_family("train_dp_bucketed", mutate="drop-grad-sync")
+    assert not rep["clean"]
+    first = rep["first_divergence"]
+    assert first["stage"] == "grads"
+    assert first["leaf"]  # a real param-tree path, not a scalar
+    assert first["n_bad"] > 0
+    assert _stage(rep, "loss")["clean"]
+
+
+def test_double_psum_localizes_at_grads():
+    rep = gradsan.run_family("train_dp_naive", mutate="double-psum")
+    assert not rep["clean"]
+    assert rep["first_divergence"]["stage"] == "grads"
+    assert _stage(rep, "loss")["clean"]
+
+
+def test_wrong_stage_skew_localizes_at_adamw_delta():
+    """A defect past the gradient pipeline must NOT implicate it: every
+    grad-level stage (and the grad-only moments) stays clean and the
+    first divergence is the AdamW delta."""
+    rep = gradsan.run_family("train_single", mutate="optimizer-lr")
+    assert not rep["clean"]
+    assert rep["first_divergence"]["stage"] == "adamw_delta"
+    for name in GRAD_STAGE_NAMES:
+        assert _stage(rep, name)["clean"], name
+    # m/v depend on grads only, not lr: still bit-clean
+    assert _stage(rep, "new_m")["clean"]
+    assert _stage(rep, "new_v")["clean"]
+
+
+def test_cli_exit_1_names_first_divergence(capsys):
+    rc = cli_main(["--step", "train_dp_bucketed", "--json",
+                   "--mutate", "drop-grad-sync"])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["first_divergence"]["stage"] == "grads"
+    assert rep["first_divergence"]["leaf"]
+    assert rep["mutation"] == "drop-grad-sync"
+
+
+def test_cli_unknown_family_exits_2(capsys):
+    rc = cli_main(["--step", "not_a_family", "--json"])
+    assert rc == 2
+    rep = json.loads(capsys.readouterr().out)
+    assert "error" in rep
+
+
+def test_cli_list_matches_module(capsys):
+    rc = cli_main(["--list", "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert tuple(rep["families"]) == gradsan.family_names()
+    assert tuple(rep["mutations"]) == gradsan.MUTATIONS
+    # every gradsan family is a registered lint step of the same name
+    from cs336_systems_tpu.analysis import registry
+
+    step_names = {s.name for s in registry.STEPS}
+    assert set(rep["families"]) <= step_names
+
+
+@pytest.mark.slow
+def test_sp_family_clean_post_fix():
+    """The family whose regression the tool root-caused: sharded sp step
+    vs single-device oracle, clean at both tolerance classes. (The ep-a2a
+    twin runs in the package gate — scripts/run_tests_and_package.sh.)"""
+    rep = gradsan.run_family("train_sp")
+    assert rep["clean"], rep["first_divergence"]

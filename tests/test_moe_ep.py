@@ -374,21 +374,15 @@ def test_moe_all_experts_get_gradients():
     assert float(jnp.max(jnp.abs(g["blocks"]["ffn"]["router"]["weight"]))) > 0.0
 
 
-# Post-AdamW parity of the a2a step is broken at a level tolerances can't
-# honestly absorb: ~40% of first-step updates flip sign (every diff bounded
-# by exactly 2*lr — the t=1 sign-quantized update), while the forward loss
-# still matches at rtol 1e-5 and gradient magnitudes are solid (median |g|
-# 2.6e-3, so this is NOT near-zero-gradient eps amplification). Pinned
-# non-strict pending a gradient-level bisection — see "a2a/sp post-AdamW
-# parity regression" in ROADMAP.md.
-_A2A_PARITY_XFAIL = pytest.mark.xfail(
-    strict=False,
-    reason="a2a backward grad-sign parity break (~40% first-step sign "
-           "flips, bounded by 2*lr) — tracked in ROADMAP.md",
-)
-
-
-@_A2A_PARITY_XFAIL
+# These oracles were the "a2a/sp post-AdamW parity regression" pins
+# (~40% first-step sign flips bounded by 2*lr). Root cause, found with
+# analysis/gradsan: in-body value_and_grad under this jax's forced
+# check_rep=False shard_map yields LOCAL gradients (no auto-psum for
+# replicated operands; the a2a transpose sums only the ep direction of
+# the expert leaves) — the step must own the reduction, which
+# ep._sync_ep_grads now issues before the norm/clip. The gradient-level
+# a2a unit tests above always passed because they differentiate OUTSIDE
+# the shard_map.
 @pytest.mark.parametrize("mesh_axes,dp", [
     ({"dp": 2, "ep": 4}, "dp"),
     ({"ep": 8}, None),
@@ -425,7 +419,6 @@ def test_ep_a2a_step_matches_unsharded(mesh_axes, dp):
     assert trees_allclose(p_ep, p_ref, rtol=1e-4, atol=1e-5)
 
 
-@_A2A_PARITY_XFAIL
 def test_ep_a2a_matches_under_forced_drops():
     """Skew the router so one expert overflows its capacity by a wide
     margin: the a2a step's drop decisions (global fill order across the
@@ -693,7 +686,6 @@ def test_gmm13_fused_bwd_unfused_fallback(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
-@_A2A_PARITY_XFAIL
 def test_ep_a2a_uneven_split_direction():
     """{dp:4, ep:2} — more dp than ep (the transpose of the main oracle
     mesh): two local experts per shard, fill order over 8 token shards."""

@@ -167,22 +167,13 @@ def test_ring_attention_windowed_matches_dense(window):
 # SP train step
 
 
-# Same post-AdamW parity break as the a2a tests (tests/test_moe_ep.py):
-# ~41% of first-step updates flip sign, every diff bounded by exactly 2*lr,
-# forward loss matches at rtol 1e-5, and gradient magnitudes are far above
-# the reassociation floor (median |g| 2.6e-3, measured) — so the sp/ring
-# BACKWARD disagrees with the single-device backward at the sign level and
-# a tolerance bump would mask a real defect. Non-strict pin; see "a2a/sp
-# post-AdamW parity regression" in ROADMAP.md. The gradient-level ring
-# tests above still pass — the break is in the composed train step.
-_SP_PARITY_XFAIL = pytest.mark.xfail(
-    strict=False,
-    reason="sp train-step grad-sign parity break (~41% first-step sign "
-           "flips, bounded by 2*lr) — tracked in ROADMAP.md",
-)
-
-
-@_SP_PARITY_XFAIL
+# These oracles were the "a2a/sp post-AdamW parity regression" pins
+# (~41% first-step sign flips bounded by 2*lr). Root cause, found with
+# analysis/gradsan: under this jax's forced check_rep=False shard_map
+# (_compat.py), in-body value_and_grad yields LOCAL per-device gradients
+# — the step must own the (dp × sp) pmean, which make_sp_train_step now
+# issues via dp.sync_grads before clip/AdamW. The gradient-level ring
+# tests above always passed because they take jax.grad OUTSIDE shard_map.
 def test_sp_train_step_matches_single_device():
     """One dp×sp step == one single-device step on the same global batch."""
     mesh = make_mesh({"dp": 2, "sp": 4})
@@ -335,7 +326,6 @@ def test_flash_shard_declared_without_mesh_raises():
         transformer_lm(params, x, cfg)
 
 
-@_SP_PARITY_XFAIL
 def test_sp_train_step_windowed_matches_single_device():
     """attn_window through the SP/ring step vs the single-device windowed
     step (window smaller than one sequence shard → truncated ring)."""

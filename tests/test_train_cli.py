@@ -67,12 +67,7 @@ def _reference_loss(capsys) -> float:
     ("zero1", []),
     ("fsdp", []),
     ("tp", ["--mesh", "dp=2,tp=4"]),
-    # sp rides the same backward parity break as tests/test_tp_sp.py's
-    # sp train-step oracles (sign-level gradient disagreement; after 4
-    # steps the loss drifts 2.7e-3 past the 2e-3 gate) — ROADMAP.md.
-    pytest.param("sp", [], marks=pytest.mark.xfail(
-        strict=False,
-        reason="sp train-step parity break — tracked in ROADMAP.md")),
+    ("sp", []),
     ("pp", ["--mesh", "dp=2,pp=2", "--microbatches", "2"]),
     ("tp_sp", ["--mesh", "dp=2,tp=2,sp=2"]),
 ])
