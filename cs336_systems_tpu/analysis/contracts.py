@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 
 from cs336_systems_tpu.analysis import jaxpr_scan
 
@@ -256,6 +257,57 @@ def check_grad_reduction(name: str, jaxpr, contract: dict) -> list[Violation]:
                 f"by the axis-size product (use lax.pmean, or scale by "
                 "1/degree as ep's expert path does)"))
     return out
+
+
+# Scope gate for the no-materialized-logits rule: only equations under the
+# LM-head / loss named_scopes are candidate logits producers. The \b-gated
+# regex keeps tiny-config shape collisions (d_ff == vocab_size in the
+# registry's 64-wide test configs) from flagging FFN activations, and an
+# underscore is a word character so "lm_loss"-style scopes do NOT match
+# a bare ``loss`` marker — only the explicit annotate("loss") island and
+# the legacy annotate-free ``lm_head`` projection scope do.
+_LOGITS_SCOPE_RE = re.compile(r"\b(lm_head|loss)\b")
+
+
+def check_no_materialized_logits(name: str, jaxpr, bound: dict) -> list[Violation]:
+    """No full ``[..., rows, vocab]`` logits tensor may be materialized in
+    the loss path: every buffer whose trailing dims are
+    ``(> bound["max_rows"], == bound["vocab"])`` under an lm_head/loss
+    scope is the [B, S, V] materialization the chunked fused CE
+    (ops/fused_ce.py) exists to eliminate — at the headline shape that
+    single buffer is ~41 MB fp32 of pure transient, and at 32k vocab it
+    dominates the training live set. ``max_rows`` is the family's
+    per-device chunk bound (fused_ce.auto_chunk of the LOCAL sequence),
+    so the per-chunk [B, chunk, V] transients of the fused path pass.
+
+    Multiple hits collapse into ONE violation (count + first site): a
+    disabled chunking path materializes the same tensor in fwd, recompute
+    and bwd, and one actionable message beats three copies."""
+    vocab = int(bound["vocab"])
+    max_rows = int(bound["max_rows"])
+    hits = 0
+    first = None
+    for eqn in jaxpr_scan.iter_eqns(jaxpr):
+        stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+        if not _LOGITS_SCOPE_RE.search(stack):
+            continue
+        for var in eqn.outvars:
+            shape = tuple(getattr(getattr(var, "aval", None), "shape", ()))
+            if len(shape) >= 2 and shape[-1] == vocab and shape[-2] > max_rows:
+                hits += 1
+                if first is None:
+                    first = (str(eqn.primitive), shape, stack)
+    if not hits:
+        return []
+    prim, shape, stack = first
+    return [Violation(
+        "no-materialized-logits", name,
+        f"{hits} loss-path buffer(s) of shape [..., rows > {max_rows}, "
+        f"vocab = {vocab}] materialized (first: {prim} -> {shape} under "
+        f"scope {stack!r}) — full [B, S, V] logits must not exist in a "
+        "training step; was ce_chunk_size=0 left on a training config? "
+        "(ops/fused_ce.py)",
+    )]
 
 
 # A dot is "big" when M, N and K are ALL at least this: the fp32 router

@@ -92,7 +92,8 @@ GRAD_STAGES = ("loss", "grads", "grad_norm", "clipped_grads")
 GRAD_RTOL, GRAD_ATOL = 1e-4, 1e-5
 ADAMW_RTOL, ADAMW_ATOL = 1e-3, 5e-4
 
-MUTATIONS = ("drop-grad-sync", "double-psum", "optimizer-lr")
+MUTATIONS = ("drop-grad-sync", "double-psum", "optimizer-lr",
+             "drop-lse-correction")
 
 
 def _tolerance(stage: str) -> tuple[str, float, float]:
@@ -336,9 +337,26 @@ def _mutation_ctx(mutate: str | None):
     historical defect; ``double-psum`` over-reduces an already-synced
     gradient (×W scale). Families that own no explicit sync (the
     single-device self-diffs, the GSPMD tp/tp_sp steps) are unaffected
-    by either — use ``optimizer-lr`` there."""
+    by either — use ``optimizer-lr`` there. ``drop-lse-correction``
+    breaks the vocab-sharded chunked CE (ops/fused_ce.py): each tp shard
+    keeps its LOCAL row max instead of the pmax'd global one, so the
+    psum'd sum-exp mixes shard-dependent offsets — wrong lse, wrong loss,
+    wrong everything downstream. Only families whose config sets
+    ``ce_vocab_axis`` (tp / tp_sp) call the seam; the single-device
+    oracle built under the same ctx uses the unsharded path and stays
+    correct, which is exactly what makes the diff fire."""
     if mutate in (None, "optimizer-lr"):
         yield
+        return
+    if mutate == "drop-lse-correction":
+        from cs336_systems_tpu.ops import fused_ce
+
+        orig = fused_ce._shard_max_correction
+        fused_ce._shard_max_correction = lambda m_local, axis: m_local
+        try:
+            yield
+        finally:
+            fused_ce._shard_max_correction = orig
         return
     from cs336_systems_tpu.parallel import dp, ep
 

@@ -64,6 +64,9 @@ def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
     if c.get("grad_reduction"):
         out += contracts.check_grad_reduction(name, traced.jaxpr,
                                               c["grad_reduction"])
+    if c.get("logits_bound"):
+        out += contracts.check_no_materialized_logits(name, traced.jaxpr,
+                                                      c["logits_bound"])
     budget = registry.HBM_BUDGET_BYTES.get(name)
     if budget:
         out += contracts.check_hbm_budget(name, budget)

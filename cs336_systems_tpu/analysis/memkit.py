@@ -506,7 +506,8 @@ def classify_buffer(info: BufferInfo, arg_classes: list[str]) -> str:
         return "kv-cache"
     if info.name.startswith("__out__") or oc == "__output__":
         return "output"
-    fwd = info.def_phase in ("fwd-attn", "fwd-ffn", "routing", "other")
+    fwd = info.def_phase in ("fwd-attn", "fwd-ffn", "loss", "routing",
+                             "other")
     if fwd and info.free_phase == "bwd":
         if re.search(r"gmm|grouped|w13", scope):
             return "gmm-residual"
@@ -630,6 +631,36 @@ def _bench_headline():
     return loop, (params, opt, xs, xs), _train_arg_classes(), 1
 
 
+def _bench_vocab32k():
+    """The headline training loop at a 32k vocab (GPT-2-class lm head):
+    the cell where the chunked fused CE (ops/fused_ce.py) matters most —
+    full logits would be ``[B, S, 32k]`` of pure loss-phase transient
+    (~1.5 GB bf16 at b48 ctx512), dwarfing every stash; the fused path
+    keeps the peak near-flat in V outside the lm-head params/moments."""
+    import jax
+
+    from cs336_systems_tpu.models.transformer import config_for_size
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+    from cs336_systems_tpu.train import init_train_state, make_train_loop
+
+    on_tpu = jax.default_backend() == "tpu"
+    steps = 10 if on_tpu else 2
+    batch = 48 if on_tpu else 2
+    cfg = config_for_size(
+        "small",
+        vocab_size=32_000,
+        context_length=512,
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        attn_impl="flash" if on_tpu else "xla",
+        scan_layers=not on_tpu,
+    )
+    params, opt = jax.eval_shape(
+        lambda k: init_train_state(k, cfg), jax.random.PRNGKey(0))
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4), donate=False)
+    xs = jax.ShapeDtypeStruct((steps, batch, 512), "int32")
+    return loop, (params, opt, xs, xs), _train_arg_classes(), 1
+
+
 def _bench_decode():
     """The batched KV-cache decode scan (scripts/trace_decode_step.py
     shapes) over abstract inputs."""
@@ -693,6 +724,7 @@ def _bench_moe():
 
 BENCH_FAMILIES: dict[str, Callable] = {
     "bench_headline": _bench_headline,
+    "train_vocab32k": _bench_vocab32k,
     "bench_decode": _bench_decode,
     "bench_moe": _bench_moe,
 }

@@ -59,7 +59,7 @@ class StepSpec:
 # attribution joins on them; "transpose(" is AD's own backward marker so
 # the bwd phase needs no hand annotation). MoE adds the router scope;
 # serving generation carries the decode-side scopes.
-TRAIN_PHASE_SCOPES = ("attn", "ffn", "optimizer", "transpose(")
+TRAIN_PHASE_SCOPES = ("attn", "ffn", "loss", "optimizer", "transpose(")
 MOE_TRAIN_PHASE_SCOPES = TRAIN_PHASE_SCOPES + ("routing",)
 SERVE_PHASE_SCOPES = ("attn", "ffn", "kv_update", "sampling")
 
@@ -78,6 +78,19 @@ def _moe_cfg(**kw):
                 scan_layers=False)
     base.update(kw)
     return _tiny_cfg(**base)
+
+
+def _logits_bound(cfg, s_shard: int = 1) -> dict:
+    """Contract payload for the no-materialized-logits rule: the loss path
+    may keep at most [B, auto_chunk(local S), V]-row transients live —
+    exactly what the chunked fused CE (ops/fused_ce.py) produces when
+    ``ce_chunk_size=None`` resolves off the per-device sequence.
+    ``s_shard`` is the family's sequence-sharding degree (the shard_map
+    families' jaxprs carry LOCAL shapes inside the island)."""
+    from cs336_systems_tpu.ops.fused_ce import auto_chunk
+
+    return {"vocab": cfg.vocab_size,
+            "max_rows": auto_chunk(cfg.context_length // s_shard)}
 
 
 def _abstract_state(cfg):
@@ -128,6 +141,7 @@ def _build_train_single() -> Traced:
         "collectives": {},
         "min_aliases": _n_leaves(state),
         "phase_scopes": TRAIN_PHASE_SCOPES,
+        "logits_bound": _logits_bound(cfg),
         "note": "single-device step: no mesh, no collectives; donation "
                 "must alias every param/moment leaf",
     }
@@ -149,6 +163,7 @@ def _build_train_single_bf16() -> Traced:
         "min_aliases": _n_leaves(state),
         "check_fp32_dots": True,
         "phase_scopes": TRAIN_PHASE_SCOPES,
+        "logits_bound": _logits_bound(cfg),
         "note": "bf16 compute path: every big dot must have bf16 operands "
                 "(fp32 accumulation via preferred_element_type only)",
     }
@@ -166,6 +181,7 @@ def _build_train_moe(dispatch: str) -> Traced:
         "min_aliases": _n_leaves(state),
         "barriers": cfg.num_layers,  # forward floor; bwd adds its own
         "phase_scopes": MOE_TRAIN_PHASE_SCOPES,
+        "logits_bound": _logits_bound(cfg),
         "note": f"single-device MoE[{dispatch}]: unrolled stack needs the "
                 "per-layer optimization_barrier; routing must be "
                 "_prefix_count (no long cumsum)",
@@ -187,7 +203,8 @@ def _build_train_dp(variant: str) -> Traced:
                               variant=variant)
     contract = dict(lint_contract(state[0], variant=variant),
                     min_aliases=_n_leaves(state),
-                    phase_scopes=TRAIN_PHASE_SCOPES)
+                    phase_scopes=TRAIN_PHASE_SCOPES,
+                    logits_bound=_logits_bound(cfg))
     return _traced_train(step, state, x, y, contract)
 
 
@@ -200,7 +217,8 @@ def _build_train_tp() -> Traced:
     x, y = _batch(cfg)
     step = make_tp_train_step(cfg, _hp(), make_mesh({"dp": 2, "tp": 4}))
     contract = dict(lint_contract(), min_aliases=_n_leaves(state),
-                    phase_scopes=TRAIN_PHASE_SCOPES)
+                    phase_scopes=TRAIN_PHASE_SCOPES,
+                    logits_bound=_logits_bound(cfg))
     return _traced_train(step, state, x, y, contract)
 
 
@@ -215,7 +233,8 @@ def _build_train_tp_sp() -> Traced:
     step = make_tp_sp_train_step(
         cfg, _hp(), make_mesh({"dp": 2, "tp": 2, "sp": 2}))
     contract = dict(lint_contract(cfg), min_aliases=_n_leaves(state),
-                    phase_scopes=TRAIN_PHASE_SCOPES)
+                    phase_scopes=TRAIN_PHASE_SCOPES,
+                    logits_bound=_logits_bound(cfg, s_shard=2))
     return _traced_train(step, state, x, y, contract)
 
 
@@ -230,7 +249,8 @@ def _build_train_sp() -> Traced:
     step = make_sp_train_step(cfg, _hp(), mesh)
     contract = dict(lint_contract(state[0], cfg, mesh),
                     min_aliases=_n_leaves(state),
-                    phase_scopes=TRAIN_PHASE_SCOPES)
+                    phase_scopes=TRAIN_PHASE_SCOPES,
+                    logits_bound=_logits_bound(cfg, s_shard=4))
     return _traced_train(step, state, x, y, contract)
 
 
@@ -244,7 +264,8 @@ def _build_train_ep_a2a() -> Traced:
     step = make_ep_train_step(cfg, _hp(), make_mesh({"dp": 2, "ep": 4}))
     contract = dict(lint_contract(cfg, n_token_axes=2),
                     min_aliases=_n_leaves(state),
-                    phase_scopes=MOE_TRAIN_PHASE_SCOPES)
+                    phase_scopes=MOE_TRAIN_PHASE_SCOPES,
+                    logits_bound=_logits_bound(cfg))
     return _traced_train(step, state, x, y, contract)
 
 

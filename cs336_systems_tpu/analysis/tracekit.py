@@ -58,7 +58,7 @@ from cs336_systems_tpu.analysis.flops import (
 
 SCHEMA = "stepprofile/v1"
 
-PHASES = ("fwd-attn", "fwd-ffn", "bwd", "optimizer", "routing",
+PHASES = ("fwd-attn", "fwd-ffn", "loss", "bwd", "optimizer", "routing",
           "kv-update", "sampling", "other")
 
 # ---------------------------------------------------------------------------
@@ -174,6 +174,12 @@ def phase_of(scope: str) -> str:
         return "routing"
     if "optimizer" in scope:
         return "optimizer"
+    # the chunked fused lm-head + CE (train.lm_loss's annotate("loss"))
+    # — must precede the ffn/lm_head regex or the fused head matmul would
+    # re-attribute to fwd-ffn (backward CE ops carry transpose( and stay
+    # bwd, like every other fused-op VJP)
+    if re.search(r"\bloss\b", scope):
+        return "loss"
     if re.search(r"\b(attn|sdpa|qkv_proj|out_proj|rope)\b", scope):
         return "fwd-attn"
     if re.search(r"\b(ffn|lm_head)\b", scope):

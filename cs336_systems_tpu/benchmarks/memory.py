@@ -121,6 +121,7 @@ def analyze_memory_cell(
     cell = {
         "size": size,
         "ctx": context_length,
+        "vocab": vocab_size,
         "phase": "fullstep" if full_step else "forward",
         "dtype": compute_dtype,
         "batch": batch_size,
@@ -165,12 +166,16 @@ def run_memory_analysis(
     context_lengths=(128, 256, 512),
     dtypes=("float32", "bfloat16"),
     batch_size: int = 4,
+    vocab_sizes=(10_000,),
     donate: bool = True,
     oom_ok: bool = True,
     out_path: str | None = None,
 ):
     """Compile-time grid sweep (see module docstring); no device memory
     needed, so every reference cell — including all of 2.7b — gets a row.
+    ``vocab_sizes`` is a first-class grid axis (``--vocab``): with the
+    chunked fused CE (ops/fused_ce.py) the analyzed peak should be near-
+    flat in V outside the lm-head params — sweep 10k vs 32k/50k to verify.
     Each cell flushes as it completes (``--out FILE.jsonl`` makes it
     durable) — a killed sweep keeps every finished cell."""
     rows = []
@@ -179,24 +184,26 @@ def run_memory_analysis(
         rows.append(row)
         emit_row(row, out_path)
 
-    for ctx in context_lengths:
-        for dtype in dtypes:
-            for full_step in (False, True):
-                try:
-                    _add(
-                        analyze_memory_cell(
-                            size, ctx, full_step, compute_dtype=dtype,
-                            batch_size=batch_size, donate=donate,
+    for vocab in vocab_sizes:
+        for ctx in context_lengths:
+            for dtype in dtypes:
+                for full_step in (False, True):
+                    try:
+                        _add(
+                            analyze_memory_cell(
+                                size, ctx, full_step, compute_dtype=dtype,
+                                batch_size=batch_size, vocab_size=vocab,
+                                donate=donate,
+                            )
                         )
-                    )
-                except Exception as e:
-                    if not oom_ok:
-                        raise
-                    _add(
-                        {"size": size, "ctx": ctx,
-                         "phase": "fullstep" if full_step else "forward",
-                         "dtype": dtype, "error": error_cell(e)}
-                    )
+                    except Exception as e:
+                        if not oom_ok:
+                            raise
+                        _add(
+                            {"size": size, "ctx": ctx, "vocab": vocab,
+                             "phase": "fullstep" if full_step else "forward",
+                             "dtype": dtype, "error": error_cell(e)}
+                        )
     return results_table(rows)
 
 
@@ -334,6 +341,11 @@ def main(argv=None) -> None:
     p.add_argument("--ctx", nargs="+", type=int, default=[128, 256, 512])
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--vocab", nargs="+", type=int, default=[10_000],
+                   help="analyze mode: vocab-size grid axis — the chunked "
+                        "fused CE keeps the fullstep peak near-flat in V "
+                        "(no [B,S,V] logits); sweep to verify (e.g. "
+                        "--vocab 10000 32000 50257)")
     p.add_argument("--snapshot-dir", default=None,
                    help="runtime mode: where device_memory_profile dumps go "
                         "(default memory_files)")
@@ -379,10 +391,12 @@ def main(argv=None) -> None:
             )
         df = run_memory_analysis(
             size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
-            batch_size=args.batch, donate=not args.no_donate,
-            out_path=args.out,
+            batch_size=args.batch, vocab_sizes=args.vocab,
+            donate=not args.no_donate, out_path=args.out,
         )
     else:
+        if args.vocab != [10_000]:
+            raise SystemExit("--vocab only applies to --mode analyze")
         df = run_memory_benchmark(
             size=args.size, context_lengths=args.ctx, dtypes=args.dtypes,
             batch_size=args.batch,

@@ -126,8 +126,28 @@ class TransformerConfig:
     # remat far cheaper than cfg.remat's whole-block recompute; it is what
     # fits the larger sorted-dispatch batches on one chip (moe_v5e.txt).
     moe_ffn_remat: bool = False
+    # Chunked fused lm-head + cross-entropy (ops/fused_ce.py): the default
+    # loss path in train.lm_loss never materializes the [B, S, V] logits —
+    # the forward/backward scan over S-chunks keeps the transient at
+    # [B, chunk, V]. None = auto chunk (S/4 clamped to [16, 128]);
+    # 0 = DISABLED (legacy full-logits cross_entropy — the lint rule's
+    # mutation switch and the parity tests' unchunked oracle); >0 = that
+    # many rows per chunk (clamped to S).
+    ce_chunk_size: int | None = None
+    # Vocab-column-parallel CE (tp/tp_sp set these via their builders):
+    # the mesh axis lm_head's vocab dim is sharded over, the batch axes
+    # the loss/dW reduce over, and — the tp_sp layout — the mesh axis S
+    # is sharded over. Requires a mesh at the lm_loss call.
+    ce_vocab_axis: str | None = None
+    ce_token_axes: tuple = ()  # batch axes, e.g. ("dp",)
+    ce_seq_axis: str | None = None
 
     def __post_init__(self):
+        object.__setattr__(self, "ce_token_axes", tuple(self.ce_token_axes))
+        if self.ce_chunk_size is not None and self.ce_chunk_size < 0:
+            raise ValueError(
+                f"ce_chunk_size must be None, 0, or positive; got "
+                f"{self.ce_chunk_size}")
         if self.d_model % self.num_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
@@ -521,14 +541,21 @@ def _block(block_params, x, cos, sin, positions, cfg: TransformerConfig,
     return x, aux
 
 
-def transformer_lm_with_aux(
+def transformer_hidden_with_aux(
     params,
     token_ids: jax.Array,
     cfg: TransformerConfig,
     positions: jax.Array | None = None,
     mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Forward pass: [B, S] int ids → ([B, S, vocab] logits, aux scalar).
+    """Forward pass up to (and including) the final norm — NO lm head.
+
+    [B, S] int ids → ([B, S, d_model] hidden states, aux scalar). The loss
+    entry (``train.lm_loss`` routing through ``ops/fused_ce.py``) consumes
+    these pre-head hidden states so the lm-head projection happens fused
+    with the cross-entropy, one S-chunk at a time — the ``[B, S, vocab]``
+    logits never exist. ``transformer_lm_with_aux`` keeps the materialized
+    head for generation and the legacy/oracle loss path.
 
     ``aux`` is the summed MoE load-balance loss over blocks (0.0 for dense
     configs). Layers run under ``lax.scan`` over the stacked block params
@@ -594,6 +621,25 @@ def transformer_lm_with_aux(
 
     with jax.named_scope("final_norm"):
         x = rmsnorm(params["ln_final"], x)
+    return x, aux
+
+
+def transformer_lm_with_aux(
+    params,
+    token_ids: jax.Array,
+    cfg: TransformerConfig,
+    positions: jax.Array | None = None,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Forward pass: [B, S] int ids → ([B, S, vocab] logits, aux scalar).
+
+    The materialized-logits entry: generation, serving, and the legacy
+    (``cfg.ce_chunk_size == 0``) loss path. Training's default loss goes
+    through ``transformer_hidden_with_aux`` + the chunked fused CE instead
+    (see that docstring).
+    """
+    x, aux = transformer_hidden_with_aux(params, token_ids, cfg, positions,
+                                         mesh)
     with jax.named_scope("lm_head"):
         return linear(params["lm_head"], x, cfg.cdtype), aux
 
@@ -611,6 +657,23 @@ def transformer_lm(
     this drops the aux term (exactly zero for dense configs).
     """
     return transformer_lm_with_aux(params, token_ids, cfg, positions, mesh)[0]
+
+
+def transformer_hidden(
+    params,
+    token_ids: jax.Array,
+    cfg: TransformerConfig,
+    positions: jax.Array | None = None,
+    mesh=None,
+) -> jax.Array:
+    """Forward pass to the post-final-norm hidden states, aux dropped.
+
+    The loss-path twin of ``transformer_lm``: [B, S] int ids →
+    [B, S, d_model] — feed to ``ops/fused_ce.fused_linear_cross_entropy``
+    with ``params["lm_head"]["weight"]``.
+    """
+    return transformer_hidden_with_aux(params, token_ids, cfg, positions,
+                                       mesh)[0]
 
 
 # ---------------------------------------------------------------------------

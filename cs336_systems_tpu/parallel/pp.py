@@ -45,7 +45,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from cs336_systems_tpu.models.layers import embedding, linear, rmsnorm, rope_cache
 from cs336_systems_tpu.models.transformer import TransformerConfig, _block
+from cs336_systems_tpu.ops.fused_ce import fused_linear_cross_entropy
 from cs336_systems_tpu.ops.nn import clip_gradients, cross_entropy
+from cs336_systems_tpu.utils.profiling import annotate
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 
 
@@ -182,8 +184,22 @@ def pipelined_loss(
     # Callers psum this masked value to report the scalar.
     hidden = outs.reshape(m * mb, s, cfg.d_model)
     hidden = rmsnorm(params["ln_final"], hidden)
-    logits = linear(params["lm_head"], hidden, cfg.cdtype)
-    loss_local = cross_entropy(logits, y.reshape(m * mb, s))
+    if cfg.ce_chunk_size == 0:  # legacy full-logits path (oracle)
+        logits = linear(params["lm_head"], hidden, cfg.cdtype)
+        with annotate("loss"):
+            loss_local = cross_entropy(logits, y.reshape(m * mb, s))
+    else:
+        # Chunked fused head + CE on the drained [m·mb, S, D] buffer: the
+        # full [m·mb, S, V] logits previously materialized HERE, in the
+        # compute dtype with no fp32 note — the fused path keeps the
+        # softmax math fp32 (per chunk) AND drops the allocation. The
+        # ``loss`` scope also ends tracekit mis-attributing CE time to
+        # lm_head. Works under manual shard_map AD: a custom_vjp is
+        # differentiated the same way, and it contains no collective.
+        with annotate("loss"):
+            loss_local = fused_linear_cross_entropy(
+                hidden, params["lm_head"]["weight"], y.reshape(m * mb, s),
+                chunk_size=cfg.ce_chunk_size, compute_dtype=cfg.cdtype)
     masked = jnp.where(idx == w - 1, loss_local, 0.0)
     if dp_axis is not None:
         masked = masked / jax.lax.axis_size(dp_axis)

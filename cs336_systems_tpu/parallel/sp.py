@@ -33,8 +33,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from cs336_systems_tpu.models.transformer import TransformerConfig, transformer_lm
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    transformer_hidden,
+    transformer_lm,
+)
+from cs336_systems_tpu.ops.fused_ce import fused_linear_cross_entropy
 from cs336_systems_tpu.ops.nn import cross_entropy
+from cs336_systems_tpu.utils.profiling import annotate
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 
 
@@ -90,8 +96,24 @@ def make_sp_train_step(
                 "the full sharded sequence"
             )
         positions = jax.lax.axis_index(sp_axis) * s_local + jnp.arange(s_local)
-        logits = transformer_lm(p, x, rcfg, positions=positions)
-        return jax.lax.pmean(cross_entropy(logits, y), axes)
+        if rcfg.ce_chunk_size == 0:  # legacy full-logits path (oracle)
+            logits = transformer_lm(p, x, rcfg, positions=positions)
+            with annotate("loss"):
+                local = cross_entropy(logits, y)
+        else:
+            # chunked fused lm-head + CE on the LOCAL sequence shard: the
+            # vocab is replicated here (only tp shards it), so the
+            # single-shard entry applies per device and the existing loss
+            # pmean below turns per-shard means into the global mean —
+            # collective counts unchanged (ops/fused_ce.py is
+            # collective-free in this form).
+            hidden = transformer_hidden(p, x, rcfg, positions=positions)
+            with annotate("loss"):
+                local = fused_linear_cross_entropy(
+                    hidden, p["lm_head"]["weight"], y,
+                    chunk_size=rcfg.ce_chunk_size,
+                    compute_dtype=rcfg.cdtype)
+        return jax.lax.pmean(local, axes)
 
     def synced_vag(p, x, y):
         # In-body grads are LOCAL (module docstring): average them over

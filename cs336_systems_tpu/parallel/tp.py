@@ -90,17 +90,38 @@ def shard_params(params, mesh: Mesh, cfg: TransformerConfig, axis: str = "tp"):
     return shard_tree(params, mesh, param_specs(cfg, axis))
 
 
-def lint_contract() -> dict:
+def lint_contract(cfg: TransformerConfig | None = None,
+                  have_dp: bool = True) -> dict:
     """Declared contract of ``make_tp_train_step`` for the static analysis
-    linter: a GSPMD step — the jaxpr carries ZERO collectives (XLA inserts
-    the matmul all-reduces and dp grad averaging at compile time from the
-    in/out shardings), so any collective appearing in the trace means a
+    linter: a GSPMD step — XLA inserts the matmul all-reduces and dp grad
+    averaging at compile time from the in/out shardings — EXCEPT the
+    vocab-column-parallel chunked CE (ops/fused_ce.py), an explicit
+    shard_map island whose psum sites ARE in the jaxpr:
+
+    - forward: 1 stacked psum (sum-exp ‖ picked) over tp in the chunk
+      scan body (the contract's "one psum pair per chunk" together with
+      the uncounted pmax max-correction; scan bodies count once) + 1
+      loss-sum psum over dp after the scan;
+    - backward: 1 dh-partials psum over tp in the scan body + 1 dW psum
+      over dp after the scan.
+
+    = 4 psum sites (2 when the mesh has no dp axis). With the chunked CE
+    disabled (``cfg.ce_chunk_size == 0``) the step is pure GSPMD again and
+    the jaxpr must carry ZERO collectives — any other collective means a
     shard_map/pmean crept into a path that is supposed to be
     sharding-annotated. Donation must still alias the full train state."""
+    if cfg is not None and cfg.ce_chunk_size == 0:
+        return {
+            "collectives": {},
+            "note": "tp (GSPMD, full-logits CE): collectives are "
+                    "compile-time-inserted, none may appear in the jaxpr",
+        }
+    psum = 4 if have_dp else 2
     return {
-        "collectives": {},
-        "note": "tp (GSPMD): collectives are compile-time-inserted, "
-                "none may appear in the jaxpr",
+        "collectives": {"psum": psum},
+        "note": "tp (GSPMD) + chunked-CE island: 1 vocab psum pair per "
+                "chunk fwd/bwd (scan body counts once) + loss/dW psums "
+                "over dp; all other collectives compile-time-inserted",
     }
 
 
@@ -156,6 +177,16 @@ def make_tp_train_step(
             attn_head_shard=tp_axis,
             attn_fold="bh",  # the shard_map region specs [B, H, S, Dh] axes
         )
+
+    if cfg.ce_chunk_size != 0 and cfg.ce_vocab_axis is None:
+        # lm_head is vocab-column-parallel here (param_specs): route
+        # lm_loss through the sharded chunked CE — an explicit shard_map
+        # island (GSPMD cannot see through the chunk scan's fp32 lse
+        # reduction without gathering the vocab shards); its psum sites
+        # are declared in lint_contract().
+        cfg = dataclasses.replace(
+            cfg, ce_vocab_axis=tp_axis,
+            ce_token_axes=(dp_axis,) if have_dp else ())
 
     step = make_update_fn(
         functools.partial(lm_loss, cfg=cfg, mesh=mesh), hp, clip_norm,

@@ -70,11 +70,22 @@ def lint_contract(cfg: TransformerConfig) -> dict:
             "tp_sp lint contract is calibrated for scan_layers=True "
             "(unrolled stacks multiply the ring's static sites per layer)"
         )
+    if cfg.ce_chunk_size == 0:
+        return {
+            "collectives": {"psum": 3, "ppermute": 4},
+            "note": "tp×sp (full-logits CE): ring shard_map island in the "
+                    "scanned block body (4 ppermute sites fwd+bwd, 3 "
+                    "psums); all tp/dp collectives are GSPMD compile-time",
+        }
+    # + the chunked-CE island (ops/fused_ce.py, same derivation as
+    # tp.lint_contract): fwd = 1 stacked vocab psum in the chunk scan + 1
+    # loss psum over (dp, sp); bwd = 1 dh vocab psum in the scan + 1 dW
+    # psum over (dp, sp) — 4 more static psum sites.
     return {
-        "collectives": {"psum": 3, "ppermute": 4},
-        "note": "tp×sp: ring shard_map island in the scanned block body "
-                "(4 ppermute sites fwd+bwd, 3 psums); all tp/dp "
-                "collectives are GSPMD compile-time",
+        "collectives": {"psum": 7, "ppermute": 4},
+        "note": "tp×sp: ring island (4 ppermutes, 3 psums) + chunked-CE "
+                "island (1 vocab psum pair per chunk fwd/bwd + loss/dW "
+                "psums over dp×sp = 4 sites); rest is GSPMD compile-time",
     }
 
 
@@ -113,6 +124,14 @@ def make_tp_sp_train_step(
         attn_head_shard=tp_axis,
         attn_fold="bh",  # the island specs [B, H, S, Dh] axes
     )
+    if rcfg.ce_chunk_size != 0 and rcfg.ce_vocab_axis is None:
+        # vocab-parallel chunked CE island (see tp.make_tp_train_step);
+        # sp shards S, so the chunk scan runs over the local sequence —
+        # fused_ce resolves the chunk off ce_seq_axis accordingly.
+        rcfg = dataclasses.replace(
+            rcfg, ce_vocab_axis=tp_axis,
+            ce_token_axes=(dp_axis,) if have_dp else (),
+            ce_seq_axis=sp_axis)
     pspecs = param_specs(cfg, tp_axis)
     ospecs = opt_state_specs(cfg, tp_axis)
     bspec = P(dp_axis if have_dp else None, sp_axis)

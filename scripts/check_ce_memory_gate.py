@@ -1,0 +1,145 @@
+"""CI gate for the chunked fused CE memory claim (ops/fused_ce.py).
+
+Signs, not thresholds: the default mem_cli --diff noise gate (10% / 1 MiB,
+BOTH must trip) is deliberately deaf to deltas this small at the tiny
+hermetic shapes, so this script asserts the DIRECTION of the change on
+freshly built chunked vs chunking-disabled (``ce_chunk_size=0``) twins —
+the same mutation switch the no-materialized-logits lint rule is tested
+against:
+
+1. train_single (the registry lint shape): the loss-phase high-water must
+   be strictly reduced, by at least one full ``[B, S, V]`` logits buffer
+   (the disabled twin materializes it in fwd AND keeps the fwd logits as
+   the CE residual across the bwd).
+2. train_vocab32k (the 32k-vocab headline loop, the shape the fused CE
+   exists for): loss-phase high-water strictly reduced, again by at least
+   the full ``[B, S, V]`` margin (~131 MB fp32 at the CPU smoke shape —
+   far beyond scheduling noise). The GLOBAL peak is informational only at
+   this shape: b2's peak sits in the transformer-bwd stash region either
+   way, and the chunked path's known cost — the fp32 ``[V, D]`` dW
+   accumulator carried through the bwd chunk scan — lands there, while
+   its [B,S,V]-sized savings land in the loss phase. At the real b48
+   shapes the logits dwarf the accumulator 30:1.
+3. The fresh train_single profile must still agree with the committed
+   pre-change artifact (results/memprofiles/) on total peak to 1% — the
+   chunked loss path must not move the tiny-shape peak, which sits in
+   fwd-attn, not the loss.
+
+Runs on the hermetic CPU mesh; exits 1 naming the first violated sign.
+Launch: JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python scripts/check_ce_memory_gate.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PRE_ARTIFACT = os.path.join(
+    os.path.dirname(__file__), "..", "results", "memprofiles",
+    "train_single.pre_chunked_ce.memprofile.json")
+
+
+def _profile_train_single(ce_chunk_size):
+    from cs336_systems_tpu.analysis import memkit, registry
+    from cs336_systems_tpu.train import make_train_step
+
+    kw = {} if ce_chunk_size is None else {"ce_chunk_size": ce_chunk_size}
+    cfg = registry._tiny_cfg(**kw)
+    params, opt = registry._abstract_state(cfg)
+    x, y = registry._batch(cfg)
+    # donate=False matches the tracekit bundle the committed artifact was
+    # profiled from (mem_cli --step train_single)
+    step = make_train_step(cfg, registry._hp(), donate=False)
+    classes = memkit._leaf_classes((params, opt, x, y),
+                                   memkit._train_arg_classes())
+    name = f"train_single[ce_chunk_size={ce_chunk_size}]"
+    return memkit.profile_callable(step, (params, opt, x, y), family=name,
+                                   arg_classes=classes), cfg
+
+
+def _profile_vocab32k(ce_chunk_size):
+    import jax
+
+    from cs336_systems_tpu.analysis import memkit
+    from cs336_systems_tpu.models.transformer import config_for_size
+    from cs336_systems_tpu.optim.adamw import AdamWHparams
+    from cs336_systems_tpu.train import init_train_state, make_train_loop
+
+    # the CPU smoke shape of memkit._bench_vocab32k, with the chunk switch
+    kw = {} if ce_chunk_size is None else {"ce_chunk_size": ce_chunk_size}
+    cfg = config_for_size("small", vocab_size=32_000, context_length=512,
+                          compute_dtype="float32", attn_impl="xla",
+                          scan_layers=True, **kw)
+    params, opt = jax.eval_shape(
+        lambda k: init_train_state(k, cfg), jax.random.PRNGKey(0))
+    loop = make_train_loop(cfg, AdamWHparams(lr=3e-4), donate=False)
+    xs = jax.ShapeDtypeStruct((2, 2, 512), "int32")
+    classes = memkit._leaf_classes((params, opt, xs, xs),
+                                   memkit._train_arg_classes())
+    name = f"train_vocab32k[ce_chunk_size={ce_chunk_size}]"
+    return memkit.profile_callable(loop, (params, opt, xs, xs), family=name,
+                                   arg_classes=classes), cfg
+
+
+def _mb(n):
+    return f"{n / 2**20:.2f}MiB"
+
+
+def main() -> int:
+    failures = []
+
+    def check(ok, msg):
+        print(("  ok    " if ok else "  FAIL  ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    print("== train_single: loss-phase high-water sign ==")
+    on, cfg = _profile_train_single(None)
+    off, _ = _profile_train_single(0)
+    b, s, v = 8, cfg.context_length, cfg.vocab_size
+    logits_bytes = b * s * v * 4  # fp32 at the lint shape
+    hw_on = on["phase_peak_bytes"].get("loss", 0)
+    hw_off = off["phase_peak_bytes"].get("loss", 0)
+    print(f"  loss high-water: chunked {_mb(hw_on)}  "
+          f"full-logits {_mb(hw_off)}  ([B,S,V] = {_mb(logits_bytes)})")
+    check(hw_on < hw_off,
+          "chunked loss-phase high-water < full-logits twin")
+    check(hw_off - hw_on >= logits_bytes,
+          "reduction >= one full [B,S,V] logits buffer")
+
+    print("== train_vocab32k: loss-phase high-water sign ==")
+    on32, cfg32 = _profile_vocab32k(None)
+    off32, _ = _profile_vocab32k(0)
+    logits32 = 2 * cfg32.context_length * cfg32.vocab_size * 4
+    hw32_on = on32["phase_peak_bytes"].get("loss", 0)
+    hw32_off = off32["phase_peak_bytes"].get("loss", 0)
+    print(f"  loss high-water: chunked {_mb(hw32_on)}  "
+          f"full-logits {_mb(hw32_off)}  ([B,S,V] = {_mb(logits32)})")
+    print(f"  global peak (informational — see module docstring): "
+          f"chunked {_mb(on32['peak_bytes'])}  "
+          f"full-logits {_mb(off32['peak_bytes'])}")
+    check(hw32_on < hw32_off,
+          "chunked 32k-vocab loss-phase high-water < full-logits twin")
+    check(hw32_off - hw32_on >= logits32,
+          "32k-vocab reduction >= one full [B,S,V] logits buffer")
+
+    print("== train_single vs committed pre-change artifact ==")
+    with open(PRE_ARTIFACT) as f:
+        pre = json.load(f)
+    drift = abs(on["peak_bytes"] - pre["peak_bytes"]) / pre["peak_bytes"]
+    print(f"  peak: fresh {_mb(on['peak_bytes'])}  "
+          f"committed {_mb(pre['peak_bytes'])}  drift {drift:.4%}")
+    check(drift <= 0.01,
+          "total peak within 1% of the committed baseline (the tiny-shape "
+          "peak sits in fwd-attn; the loss path must not move it)")
+
+    if failures:
+        print(f"ce-memory-gate: {len(failures)} sign violation(s)")
+        return 1
+    print("ce-memory-gate: all signs hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""On-chip numerics check for the fused-CE Pallas forward chunk kernel
+(ops/fused_ce.py impl="pallas") against the XLA scan oracle — the real-
+Mosaic half of the Pallas convention (the interpret=True half lives in
+tests/test_fused_ce.py). Run on the TPU (NO JAX_PLATFORMS=cpu):
+
+    PYTHONPATH=.:$PYTHONPATH python scripts/check_fused_ce_chip.py
+"""
+import jax, jax.numpy as jnp, numpy as np
+from cs336_systems_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+B, S, D, V = 8, 512, 768, 10_000          # the headline loss shape
+h = (jax.random.normal(k1, (B, S, D)) * 0.7).astype(jnp.bfloat16)
+w = (jax.random.normal(k2, (V, D)) * 0.2).astype(jnp.bfloat16)
+t = jax.random.randint(k3, (B, S), 0, V)
+
+def run(impl, vocab=None):
+    hh, ww, tt = (h, w, t) if vocab is None else (
+        h, w[:vocab], jnp.minimum(t, vocab - 1))
+    def f(hh, ww):
+        return fused_linear_cross_entropy(
+            hh, ww, tt, compute_dtype="bfloat16", impl=impl)
+    loss, grads = jax.value_and_grad(f, argnums=(0, 1))(hh, ww)
+    return float(loss), grads
+
+loss_p, grads_p = run("pallas")
+loss_x, grads_x = run("xla")
+# same discipline as the interpret test: loss near-exact (both reduce in
+# fp32), grads at bf16 grad tolerance (the lse residual's last-ulp shifts
+# feed exp() in the shared recompute backward)
+np.testing.assert_allclose(loss_p, loss_x, rtol=1e-5, atol=1e-6)
+for g_p, g_x, name in zip(grads_p, grads_x, ("dh", "dW")):
+    np.testing.assert_allclose(np.asarray(g_p, np.float32),
+                               np.asarray(g_x, np.float32),
+                               rtol=1e-3, atol=1e-4, err_msg=name)
+
+# non-lane-multiple vocab: the padded tile masking must hold on real Mosaic
+loss_p2, _ = run("pallas", vocab=9_999)
+loss_x2, _ = run("xla", vocab=9_999)
+np.testing.assert_allclose(loss_p2, loss_x2, rtol=1e-5, atol=1e-6)
+print(f"ON-CHIP fused-CE pallas vs xla OK; loss {loss_p:.6f} vs {loss_x:.6f}, "
+      f"V=9999 {loss_p2:.6f} vs {loss_x2:.6f}")

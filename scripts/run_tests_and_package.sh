@@ -85,6 +85,37 @@ if [ "$gradsan_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$gradsan_status
 
+# chunked-CE memory gate: sign assertions on freshly built chunked vs
+# chunking-disabled (ce_chunk_size=0) twins — loss-phase high-water must
+# drop by at least one full [B,S,V] logits buffer at BOTH the registry
+# lint shape and the 32k-vocab loop — plus a 1% drift check of the fresh
+# train_single peak against the committed pre-change memprofile
+# (results/memprofiles/). This subsumes a raw `mem_cli --diff` against
+# that artifact: the dual noise gate cannot assert a sign, and the new
+# `loss` phase scope would flag by construction (missing phase == 0).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python scripts/check_ce_memory_gate.py
+ce_status=$?
+# ... and the raw diff against the pre-change artifact must FLAG the loss
+# phase (exit 1 — the phase is new + its high-water moved; exit 0 would
+# mean the chunked loss path silently stopped changing the profile)
+if [ "$ce_status" -eq 0 ] && [ -f /tmp/mem_smoke.memprofile.json ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.mem_cli \
+        --diff results/memprofiles/train_single.pre_chunked_ce.memprofile.json \
+        /tmp/mem_smoke.memprofile.json
+    [ $? -eq 1 ] || ce_status=1
+fi
+# the gradsan seam must still trip: seeding the broken cross-vocab-shard
+# max correction has to exit 1 at the loss stage on a tp family
+if [ "$ce_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.gradsan --step train_tp --json \
+        --mutate drop-lse-correction > /tmp/gradsan_ce_mutate.json
+    [ $? -eq 1 ] || ce_status=1
+fi
+[ "$status" -eq 0 ] && status=$ce_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -463,6 +463,86 @@ def test_explicit_sync_families_declare_grad_reduction():
         params, registry._tiny_cfg(), mesh)
 
 
+# --- no-materialized-logits -------------------------------------------------
+
+
+def test_no_materialized_logits_mutation_flagged():
+    """Disable chunking (``ce_chunk_size=0`` — the legacy full-logits CE)
+    on the exact train_single build shape and the rule must fire: the
+    [B, S, V] logits live in the lm_head/loss scopes in fwd AND bwd. The
+    default chunked build of the same shape passes (test_full_lint_clean
+    covers every registered family)."""
+    from cs336_systems_tpu.train import make_train_step
+
+    cfg = registry._tiny_cfg(ce_chunk_size=0)
+    state = registry._abstract_state(cfg)
+    x, y = registry._batch(cfg)
+    jaxpr = jax.make_jaxpr(make_train_step(cfg, registry._hp()))(*state, x, y)
+    vs = contracts.check_no_materialized_logits(
+        "train_single[ce=0]", jaxpr, registry._logits_bound(cfg))
+    assert _rules(vs) == {"no-materialized-logits"}
+    assert "ce_chunk_size=0" in vs[0].message
+
+
+def test_no_materialized_logits_scope_gated():
+    """The rule keys on the lm_head/loss named_scopes, so the tiny-config
+    shape collision (d_ff == vocab_size == 64 in the registry configs)
+    cannot flag FFN activations; neither does an ``lm_loss`` scope leak
+    a bare ``loss`` word-boundary match."""
+    bound = {"vocab": 64, "max_rows": 16}
+
+    def ffn_like(x, w):
+        with jax.named_scope("ffn"):
+            a = x @ w  # [8, 64, 64]: vocab-shaped but NOT loss-scoped
+        with jax.named_scope("lm_loss"):
+            b = a + 1.0  # underscore = word char: \bloss\b must not match
+        return b
+
+    x = jax.ShapeDtypeStruct((8, 64, 32), jnp.float32)
+    w = jax.ShapeDtypeStruct((32, 64), jnp.float32)
+    jaxpr = jax.make_jaxpr(ffn_like)(x, w)
+    assert contracts.check_no_materialized_logits("t", jaxpr, bound) == []
+
+    def loss_like(x, w):
+        with jax.named_scope("loss"):
+            return x @ w
+
+    jaxpr = jax.make_jaxpr(loss_like)(x, w)
+    vs = contracts.check_no_materialized_logits("t", jaxpr, bound)
+    assert _rules(vs) == {"no-materialized-logits"}
+
+
+def test_no_materialized_logits_chunk_transients_pass():
+    """The fused path's per-chunk [B, chunk, V] transients sit exactly AT
+    the bound (max_rows = auto_chunk(S)), so the rule's strict inequality
+    admits them — directly on the fused-CE VJP jaxpr."""
+    from cs336_systems_tpu.ops.fused_ce import (
+        auto_chunk, fused_linear_cross_entropy)
+
+    b, s, d, v = 2, 64, 16, 64
+
+    def loss_fn(h, w, t):
+        return fused_linear_cross_entropy(h, w, t)
+
+    h = jax.ShapeDtypeStruct((b, s, d), jnp.float32)
+    w = jax.ShapeDtypeStruct((v, d), jnp.float32)
+    t = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    jaxpr = jax.make_jaxpr(jax.grad(loss_fn, argnums=(0, 1)))(h, w, t)
+    bound = {"vocab": v, "max_rows": auto_chunk(s)}
+    assert contracts.check_no_materialized_logits("t", jaxpr, bound) == []
+
+
+def test_all_training_families_declare_logits_bound():
+    """Every registered training family must carry the contract key, so
+    the rule cannot silently rot out of the registry."""
+    for spec in registry.STEPS:
+        if not spec.name.startswith("train"):
+            continue
+        traced = spec.build()
+        assert "logits_bound" in traced.contract, spec.name
+        assert traced.contract["logits_bound"]["max_rows"] >= 1
+
+
 # --- exit codes -------------------------------------------------------------
 
 

@@ -1,0 +1,223 @@
+"""Chunked fused lm-head + CE (ops/fused_ce.py) parity tests.
+
+Oracle: the unchunked composition the repo already trusts —
+``models.layers.linear`` + ``ops.nn.cross_entropy`` (itself custom-VJP'd
+and reference-tested). Chunking is row-parallel along S: every per-row
+quantity (lse, picked logit, softmax row) is identical chunked vs
+unchunked, so value AND gradients must agree at grad-level tolerance
+across chunk sizes {1, non-divisor, S/4, S}, dtypes {fp32, bf16}, and
+the Pallas-kernel forward (interpret=True — CI has no TPU; the on-chip
+run is queued in results/). The vocab-sharded variant (tp / tp_sp
+layouts) is oracle-tested on the 8-virtual-device CPU mesh (conftest),
+same discipline as tests/test_tp_sp.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs336_systems_tpu.models.layers import linear
+from cs336_systems_tpu.ops.fused_ce import (
+    auto_chunk,
+    fused_linear_cross_entropy,
+    fused_linear_cross_entropy_sharded,
+)
+from cs336_systems_tpu.ops.nn import cross_entropy
+from cs336_systems_tpu.parallel.mesh import make_mesh
+
+B, S, D, V = 4, 64, 32, 96
+
+
+def _data(dtype=jnp.float32, key=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(key), 3)
+    h = (jax.random.normal(k1, (B, S, D)) * 0.7).astype(dtype)
+    w = (jax.random.normal(k2, (V, D)) * 0.2).astype(dtype)
+    t = jax.random.randint(k3, (B, S), 0, V)
+    return h, w, t
+
+
+def _oracle_loss(h, w, t, cdtype):
+    return cross_entropy(linear({"weight": w}, h, cdtype), t)
+
+
+def _tol(dtype):
+    # fp32: chunking only reassociates the scalar loss sum and the fp32 dW
+    # accumulation — near-exact. bf16: dh is produced by the same bf16
+    # matmul both ways; dW differs by the fused path's fp32 accumulator
+    # (BETTER than the oracle's, bounded by bf16 resolution on the cast).
+    if dtype == jnp.float32:
+        return dict(rtol=1e-5, atol=1e-6)
+    return dict(rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("chunk", [1, 50, None, S])
+def test_fused_ce_matches_unchunked_oracle(chunk, dtype):
+    """Loss and (dh, dW) match the full-logits oracle at grad tolerance —
+    chunk=1 (degenerate row-at-a-time), 50 (non-divisor of S=64: padded
+    tail chunk masked), None (auto_chunk = S/4), S (single chunk)."""
+    cdtype = "bfloat16" if dtype == jnp.bfloat16 else "float32"
+    h, w, t = _data(dtype)
+
+    def fused(h, w):
+        return fused_linear_cross_entropy(
+            h, w, t, chunk_size=chunk, compute_dtype=cdtype)
+
+    def ref(h, w):
+        return _oracle_loss(h, w, t, cdtype)
+
+    loss, grads = jax.value_and_grad(fused, argnums=(0, 1))(h, w)
+    loss_r, grads_r = jax.value_and_grad(ref, argnums=(0, 1))(h, w)
+    tol = _tol(dtype)
+    np.testing.assert_allclose(np.asarray(loss, np.float32),
+                               np.asarray(loss_r, np.float32), **tol)
+    for g, g_r, name in zip(grads, grads_r, ("dh", "dW")):
+        assert g.dtype == g_r.dtype, name
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(g_r, np.float32),
+                                   err_msg=name, **tol)
+
+
+def test_auto_chunk_bounds():
+    assert auto_chunk(64) == 16          # S/4 at the registry shape
+    assert auto_chunk(512) == 128        # S/4 == cap
+    assert auto_chunk(65536) == 128      # long-context cap
+    assert auto_chunk(16) == 16          # floor clamps to S
+    assert auto_chunk(3) == 3            # never exceeds S
+    with pytest.raises(ValueError):
+        fused_linear_cross_entropy(
+            jnp.zeros((1, 4, 8)), jnp.zeros((16, 8)),
+            jnp.zeros((1, 4), jnp.int32), chunk_size=0)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_interpret_matches_xla(dtype):
+    """The Pallas forward chunk kernel (interpret=True on CPU) against the
+    XLA scan oracle: same loss, same grads (the backward is the shared
+    XLA recompute — what differs per impl is the lse/picked residual the
+    kernel produces)."""
+    cdtype = "bfloat16" if dtype == jnp.bfloat16 else "float32"
+    h, w, t = _data(dtype)
+
+    def run(impl):
+        def f(h, w):
+            return fused_linear_cross_entropy(
+                h, w, t, compute_dtype=cdtype, impl=impl)
+
+        return jax.value_and_grad(f, argnums=(0, 1))(h, w)
+
+    loss_x, grads_x = run("xla")
+    loss_p, grads_p = run("pallas_interpret")
+    # both reduce in fp32; the online (streamed-max) vs two-pass softmax
+    # reassociation is the only difference. At bf16 the lse residual's
+    # last-ulp shifts feed exp() in the shared recompute backward, so
+    # near-zero dW entries move by O(1e-5) — grad-level atol, not exact.
+    gtol = (dict(rtol=1e-5, atol=1e-5) if dtype == jnp.float32
+            else dict(rtol=1e-3, atol=1e-4))
+    np.testing.assert_allclose(np.asarray(loss_p, np.float32),
+                               np.asarray(loss_x, np.float32),
+                               rtol=1e-5, atol=1e-6)
+    for g_p, g_x, name in zip(grads_p, grads_x, ("dh", "dW")):
+        np.testing.assert_allclose(np.asarray(g_p, np.float32),
+                                   np.asarray(g_x, np.float32),
+                                   err_msg=name, **gtol)
+
+
+def test_pallas_interpret_vocab_not_tile_multiple():
+    """V=100 is not a lane-tile multiple: the kernel's padded vocab tile
+    must be masked out of max/sum-exp/picked (the -inf / isfinite guards)."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    h = jax.random.normal(k1, (2, 16, 8))
+    w = jax.random.normal(k2, (100, 8)) * 0.3
+    t = jax.random.randint(k3, (2, 16), 0, 100)
+    loss_x = fused_linear_cross_entropy(h, w, t, impl="xla")
+    loss_p = fused_linear_cross_entropy(h, w, t, impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(loss_p), np.asarray(loss_x),
+                               rtol=1e-6, atol=1e-7)
+
+
+# --- vocab-sharded variant (tp / tp_sp layouts) -----------------------------
+
+
+@pytest.mark.parametrize("chunk", [None, 50])
+def test_sharded_tp_matches_unsharded(chunk):
+    """Vocab-column-parallel CE on the tp mesh against the single-device
+    fused path: the pmax/psum decomposition of the logsumexp is exact up
+    to fp reassociation."""
+    mesh = make_mesh({"tp": 4})
+    h, w, t = _data()
+
+    def sharded(h, w):
+        return fused_linear_cross_entropy_sharded(
+            h, w, t, mesh=mesh, vocab_axis="tp", chunk_size=chunk)
+
+    def ref(h, w):
+        return fused_linear_cross_entropy(h, w, t, chunk_size=chunk)
+
+    loss, grads = jax.value_and_grad(jax.jit(sharded), argnums=(0, 1))(h, w)
+    loss_r, grads_r = jax.value_and_grad(ref, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(loss_r),
+                               rtol=1e-5, atol=1e-6)
+    for g, g_r, name in zip(grads, grads_r, ("dh", "dW")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_r),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_sharded_tp_sp_matches_unsharded():
+    """The 3-axis layout (batch over dp, S over sp, vocab over tp): the
+    chunk scan runs over the LOCAL sequence and the loss/dW psums close
+    over ALL token axes — must still match the single-device fused path."""
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    h, w, t = _data()
+
+    def sharded(h, w):
+        return fused_linear_cross_entropy_sharded(
+            h, w, t, mesh=mesh, vocab_axis="tp", batch_axes=("dp",),
+            seq_axis="sp")
+
+    def ref(h, w):
+        return fused_linear_cross_entropy(h, w, t)
+
+    loss, grads = jax.value_and_grad(jax.jit(sharded), argnums=(0, 1))(h, w)
+    loss_r, grads_r = jax.value_and_grad(ref, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(loss_r),
+                               rtol=1e-5, atol=1e-6)
+    for g, g_r, name in zip(grads, grads_r, ("dh", "dW")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_r),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_train_step_chunked_matches_full_logits():
+    """End-to-end oracle at the train-step level: one step with the
+    default chunked loss path vs one with ``ce_chunk_size=0`` (the legacy
+    full-logits CE) — loss near-exact, post-AdamW params at the
+    eps-amplification tolerance (tests/test_pp.py derivation)."""
+    from cs336_systems_tpu.models.transformer import (
+        TransformerConfig, init_transformer_lm)
+    from cs336_systems_tpu.optim.adamw import AdamWHparams, adamw_init
+    from cs336_systems_tpu.train import make_train_step
+
+    def one_step(ce_chunk_size):
+        cfg = TransformerConfig(
+            vocab_size=64, context_length=32, d_model=32, num_layers=2,
+            num_heads=4, d_ff=64, ce_chunk_size=ce_chunk_size)
+        params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+        opt = adamw_init(params)
+        k = jax.random.PRNGKey(7)
+        x = jax.random.randint(k, (4, cfg.context_length), 0, cfg.vocab_size)
+        y = jnp.roll(x, -1, axis=-1)
+        step = make_train_step(cfg, AdamWHparams(lr=1e-3), donate=False)
+        new_params, _, loss = step(params, opt, x, y)
+        return loss, new_params
+
+    loss_c, params_c = one_step(None)
+    loss_f, params_f = one_step(0)
+    np.testing.assert_allclose(np.asarray(loss_c), np.asarray(loss_f),
+                               rtol=1e-6, atol=1e-7)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params_c),
+            jax.tree_util.tree_leaves_with_path(params_f)):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=5e-4, err_msg=str(pa))

@@ -54,6 +54,28 @@ def test_double_psum_localizes_at_grads():
     assert _stage(rep, "loss")["clean"]
 
 
+def test_drop_lse_correction_localizes_at_loss():
+    """Break the chunked CE's cross-vocab-shard max correction
+    (ops/fused_ce._shard_max_correction -> identity): each tp shard mixes
+    shard-local max offsets into the psum'd sum-exp, so the sharded loss
+    itself is wrong — the FIRST stage diverges, unlike the grad-sync
+    defects whose forward loss matches."""
+    rep = gradsan.run_family("train_tp", mutate="drop-lse-correction")
+    assert not rep["clean"]
+    assert rep["first_divergence"]["stage"] == "loss"
+
+
+def test_drop_lse_correction_only_hits_vocab_sharded_families():
+    """The seam lives in the sharded CE island; a family whose config
+    never sets ``ce_vocab_axis`` (the dp explicit-sync step runs the
+    single-shard fused CE) must stay bit-clean under the mutation —
+    the same discipline that keeps drop-grad-sync from implicating
+    GSPMD families."""
+    rep = gradsan.run_family("train_dp_bucketed",
+                             mutate="drop-lse-correction")
+    assert rep["clean"]
+
+
 def test_wrong_stage_skew_localizes_at_adamw_delta():
     """A defect past the gradient pipeline must NOT implicate it: every
     grad-level stage (and the grad-only moments) stays clean and the
