@@ -8,7 +8,7 @@ finding hinged on memory (training b48 OOMs under gmm because of the h/g
 residuals, ctx-65536 needs ``--remat`` or it stashes 25 GB, the fused
 flash backward lives or dies on a 16M/18.3M VMEM boundary; BASELINE.md).
 
-What it does, per registered step family (the same 14 train/serve
+What it does, per registered step family (the same 16 train/serve
 families tracekit drives, plus the headline/decode/MoE bench shapes):
 
 - lowers the step over its (tiny or abstract) inputs and compiles it,
@@ -569,7 +569,7 @@ def xla_memory_stats(compiled) -> dict:
 # ---------------------------------------------------------------------------
 # Step families
 #
-# The 14 registered train/serve families reuse tracekit's runnable
+# The 16 registered train/serve families reuse tracekit's runnable
 # bundles (same factories as train_cli/parallel.serve, donate=False so
 # the bundle is reusable). ARG_CLASSES labels each family's top-level
 # arguments; flattened leaf order matches entry parameter numbering.
@@ -601,6 +601,11 @@ ARG_CLASSES: dict[str, tuple] = {
     "serve_ep": _serve_arg_classes(),
     "serve_tp_ragged": _serve_arg_classes(),
     "serve_ragged_paged": _serve_arg_classes(),
+    # engine step args: (params, pool, logits, keys, pos, active,
+    # row_off, tables) — the page pool is THE kv-cache allocation
+    # (ISSUE 8: mem_cli must attribute it under kv-cache)
+    "serve_engine": ("params", "kv-cache", "batch", "batch", "batch",
+                     "batch", "batch", "batch"),
 }
 
 

@@ -363,6 +363,65 @@ def serve_ragged_lens(paged: bool):
     return lens
 
 
+def serve_engine_geometry():
+    """Registry geometry for the ``serve_engine`` family, shared with
+    tracekit/memkit and the tests so the shapes cannot drift:
+    ``(slots, n_pages, max_blocks, page_block)``. dp8 mesh, one slot per
+    shard; each shard's local pool holds 2 real pages + the scratch page
+    — exactly a prompt-6 + max_new-4 request at 8-row pages — so the
+    family is the engine at full occupancy, every page allocated."""
+    return 8, 2, 2, SERVE_PAGED_BLOCK
+
+
+def serve_engine_state(concrete: bool = False):
+    """The serve_engine step's argument bundle (after params/pool):
+    logits, per-slot key chains, positions, active mask, row offsets,
+    block tables. Abstract ShapeDtypeStructs for the lint trace;
+    ``concrete=True`` builds the mid-generation full-occupancy state
+    tracekit profiles (every slot active, positions past the prompt,
+    tables naming the shard-local pages in block order)."""
+    slots, n_pages, max_blocks, blk = serve_engine_geometry()
+    cfg = _tiny_cfg()
+    shapes = (
+        ((slots, cfg.vocab_size), jnp.float32),   # carried logits
+        ((slots, 2), jnp.uint32),                 # per-slot key chains
+        ((slots,), jnp.int32),                    # positions
+        ((slots,), jnp.int32),                    # active mask
+        ((slots,), jnp.int32),                    # global row offsets
+        ((slots, max_blocks), jnp.int32),         # block tables
+    )
+    if not concrete:
+        return tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes)
+    logits = jnp.zeros(shapes[0][0], jnp.float32)
+    keys = jnp.tile(jax.random.PRNGKey(3)[None, :], (slots, 1))
+    pos = jnp.full((slots,), 6, jnp.int32)        # prompt consumed
+    active = jnp.ones((slots,), jnp.int32)
+    row_off = jnp.arange(slots, dtype=jnp.int32)
+    tables = jnp.tile(jnp.arange(max_blocks, dtype=jnp.int32)[None, :],
+                      (slots, 1))                 # shard-local page ids
+    return logits, keys, pos, active, row_off, tables
+
+
+def _build_serve_engine() -> Traced:
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import lint_contract
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    slots, n_pages, _, blk = serve_engine_geometry()
+    step = make_engine_step(cfg, blk, mesh=make_mesh({"dp": 8}),
+                            dp_axis="dp", temperature=0.9, top_k=8,
+                            donate=False)
+    pool = tuple(jax.ShapeDtypeStruct(
+        (slots * (n_pages + 1), cfg.num_heads, blk, 2 * cfg.d_head),
+        cfg.cdtype) for _ in range(cfg.num_layers))
+    jaxpr = jax.make_jaxpr(step)(_abstract_params(cfg), pool,
+                                 *serve_engine_state())
+    contract = dict(lint_contract(cfg, dp_axis="dp", decode_only=True),
+                    phase_scopes=SERVE_PHASE_SCOPES)
+    return Traced(jaxpr, None, contract)
+
+
 STEPS: tuple[StepSpec, ...] = (
     StepSpec("train_single", _build_train_single),
     StepSpec("train_single_bf16", _build_train_single_bf16),
@@ -389,6 +448,7 @@ STEPS: tuple[StepSpec, ...] = (
     StepSpec("serve_ragged_paged",
              functools.partial(_build_serve, {"dp": 8}, "dp",
                                None, None, True, True)),
+    StepSpec("serve_engine", _build_serve_engine),
 )
 
 
@@ -408,4 +468,8 @@ HBM_BUDGET_BYTES: dict[str, int] = {
     "serve_ragged_paged": 1 << 20,  # analyzed peak ~0.20 MB — the paged
     # pool keeps the skewed family's peak BELOW serve_dp's budget even
     # with the page tables and prefill page gather in the program
+    "serve_engine": 1 << 19,    # analyzed peak ~0.13 MB — the engine's
+    # steady-state step at full occupancy; the slot state is tiny and the
+    # pool (kv-cache class) is THE multi-page allocation, so budget creep
+    # here means the step started materializing per-slot copies
 }

@@ -552,6 +552,40 @@ def _build_serve(mesh_axes, dp_axis, tp_axis=None, ep_axis=None,
     return Runner(fn, (params, ids, key), b * max_new, flops, mesh.size)
 
 
+def _build_serve_engine() -> Runner:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from cs336_systems_tpu.analysis.registry import (
+        _tiny_cfg, serve_engine_geometry, serve_engine_state)
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import engine_specs
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    slots, n_pages, _, blk = serve_engine_geometry()
+    step = make_engine_step(cfg, blk, mesh=mesh, dp_axis="dp",
+                            temperature=0.9, top_k=8, donate=False)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    _, pool_spec, _ = engine_specs(cfg, "dp", None)
+    sh = NamedSharding(mesh, pool_spec)
+    pool = tuple(jax.device_put(
+        jnp.zeros((slots * (n_pages + 1), cfg.num_heads, blk,
+                   2 * cfg.d_head), cfg.cdtype), sh)
+        for _ in range(cfg.num_layers))
+    state = serve_engine_state(concrete=True)
+    # one token per slot per step; every slot attends its 6-token prompt
+    # + the new token (the full-occupancy state serve_engine_state builds)
+    flops = decode_flops_per_token(
+        cfg, attend_lens=np.full((slots,), 7, np.int64))
+    return Runner(step, (params, pool) + tuple(state), slots, flops,
+                  mesh.size)
+
+
 FAMILIES: dict[str, Callable[[], Runner]] = {
     "train_single": _build_train_single,
     "train_single_bf16": _build_train_single_bf16,
@@ -570,6 +604,7 @@ FAMILIES: dict[str, Callable[[], Runner]] = {
                                             None, True),
     "serve_ragged_paged": lambda: _build_serve({"dp": 8}, "dp", None, None,
                                                True, True),
+    "serve_engine": _build_serve_engine,
 }
 
 

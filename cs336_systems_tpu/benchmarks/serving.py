@@ -1,0 +1,231 @@
+"""Arrival-process serving benchmark: the continuous-batching engine under
+poisson arrivals — goodput under an SLO, p50/p99 per-token latency, and
+tokens/s at each offered load.
+
+The decode benchmark (benchmarks/decode.py) measures the STEP: a fixed
+batch, everything resident, device ms per token. This driver measures the
+SYSTEM the engine adds on top: requests arrive over time (exponential
+gaps at ``--load`` requests/s), queue behind a fixed-capacity slot batch
+and a finite page pool, join mid-flight, and stream tokens back — so the
+numbers that come out are the serving numbers the step benchmark cannot
+produce: time-to-first-token, inter-token latency percentiles across the
+whole trace, and GOODPUT (tokens/s counting only requests whose mean
+per-token latency met ``--slo-ms``) as load approaches saturation.
+
+Prompt-length profiles reuse the decode benchmark's skew semantics
+(--skew spike/zipf there; ``--profiles`` here): ``uniform`` = every
+prompt at P, ``zipf`` = len_i = P/(i+1) clipped to 1 (a few long, many
+short), ``spike`` = all but one at P/8 plus one straggler at P. Skewed
+profiles are where the page pool earns its keep — short requests join
+and leave while a straggler holds its slot.
+
+Every cell flushes via ``emit_row`` the moment it completes (``--out``
+makes the cells durable JSONL), and every trace ends with the page-pool
+conservation check — a leaked page fails the cell, which is the CI
+smoke's no-leak gate (scripts/run_tests_and_package.sh).
+
+Measurement caveat (CLAUDE.md): on the remote-dispatch runtime every
+engine step carries the ~7 ms dispatch cost and wall latencies swing with
+the tunnel; absolute latencies are floor + device time, and the robust
+signals are the RATIOS across loads/profiles and the saturation point.
+The on-chip goodput sweep is queued in results/decode_v5e.txt.
+
+Run: ``python -m cs336_systems_tpu.benchmarks.serving --test-model
+--requests 12 --loads 20 --new 8`` (CPU smoke) or with real sizes
+``--size small --loads 2 5 10 --profiles uniform zipf spike``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    config_for_size,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.serving import Request, ServingEngine
+from cs336_systems_tpu.utils.timing import emit_row, print_table, results_table
+
+
+def profile_lens(profile: str, n: int, prompt_len: int) -> np.ndarray:
+    """Per-request prompt lengths — same shapes as benchmarks/decode.py's
+    --skew rows so the two artifacts compare like for like."""
+    if profile == "uniform":
+        return np.full(n, prompt_len, int)
+    if profile == "zipf":
+        return np.maximum(prompt_len // (np.arange(n) + 1), 1)
+    if profile == "spike":
+        lens = np.full(n, max(prompt_len // 8, 1), int)
+        lens[-1] = prompt_len
+        return lens
+    raise ValueError(f"unknown profile {profile!r}")
+
+
+def build_requests(profile: str, n: int, prompt_len: int, new_tokens: int,
+                   load_rps: float, vocab: int, seed: int) -> list[Request]:
+    """Poisson arrivals: exponential inter-arrival gaps at ``load_rps``."""
+    rng = np.random.default_rng(seed)
+    lens = profile_lens(profile, n, prompt_len)
+    arrivals = np.cumsum(rng.exponential(1.0 / load_rps, size=n))
+    return [
+        Request(rid=i, prompt=rng.integers(0, vocab, size=int(lens[i])),
+                max_new_tokens=new_tokens, arrival=float(arrivals[i]))
+        for i in range(n)
+    ]
+
+
+def run_cell(engine: ServingEngine, requests: list[Request],
+             slo_ms: float) -> dict:
+    """Drive one trace to completion and reduce it to the cell's row.
+
+    Per-token latency samples: a request's first sample is time-to-first-
+    token (first emit − arrival), the rest are inter-token gaps. p50/p99
+    are over ALL token samples in the trace; goodput counts only tokens
+    from requests whose MEAN per-token latency met the SLO."""
+    for r in requests:
+        engine.submit(r)
+    t0 = time.monotonic()
+    results = engine.run()
+    engine.check_idle()  # pool conservation: the no-leak gate
+
+    assert set(results) == {r.rid for r in requests}, "requests lost"
+    samples, good_tokens, total_tokens, ttfts = [], 0, 0, []
+    t_end = 0.0
+    for r in requests:
+        if not r.emit_times:      # finished at EOS before emitting
+            continue
+        lat = np.diff([r.arrival] + r.emit_times)
+        samples.extend(lat.tolist())
+        ttfts.append(lat[0])
+        total_tokens += len(r.tokens)
+        if float(lat.mean()) * 1e3 <= slo_ms:
+            good_tokens += len(r.tokens)
+        t_end = max(t_end, r.finish_time)
+    makespan = max(t_end - min(r.arrival for r in requests), 1e-9)
+    samples = np.asarray(samples) if samples else np.zeros(1)
+    return {
+        "completed": len(results),
+        "tokens": total_tokens,
+        "steps": engine.steps,
+        "makespan_s": round(makespan, 4),
+        "tok_s": round(total_tokens / makespan, 2),
+        "goodput_tok_s": round(good_tokens / makespan, 2),
+        "p50_ms": round(float(np.percentile(samples, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(samples, 99)) * 1e3, 3),
+        "ttft_mean_ms": round(float(np.mean(ttfts)) * 1e3, 3)
+        if ttfts else 0.0,
+    }
+
+
+def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
+          prompt_len: int, new_tokens: int, slots: int, n_pages: int,
+          max_blocks: int, page_block: int, dp: int, seed: int,
+          slo_ms: float, out_path: str | None) -> list[dict]:
+    params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
+    mesh = dp_axis = None
+    if dp:
+        from cs336_systems_tpu.parallel.mesh import make_mesh
+
+        mesh, dp_axis = make_mesh({"dp": dp}), "dp"
+    rows = []
+    for load in loads:
+        for profile in profiles:
+            t0 = time.monotonic()
+            # fresh engine per cell: the trace starts at clock 0 with a
+            # cold pool, so cells are independent and replayable
+            engine = ServingEngine(
+                params, cfg, key=jax.random.PRNGKey(0), slots=slots,
+                n_pages=n_pages, max_blocks=max_blocks,
+                page_block=page_block, temperature=0.9, top_k=8,
+                mesh=mesh, dp_axis=dp_axis,
+                clock=lambda: time.monotonic() - t0)
+            reqs = build_requests(profile, n_requests, prompt_len,
+                                  new_tokens, load, cfg.vocab_size, seed)
+            row = {"name": f"engine_poisson_{profile}_load{load:g}",
+                   "load_rps": load, "profile": profile,
+                   "requests": n_requests, "slots": slots,
+                   "n_pages": n_pages, "slo_ms": slo_ms}
+            row.update(run_cell(engine, reqs, slo_ms))
+            emit_row(row, out_path)
+            rows.append(row)
+    return rows
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--size", default="small",
+                   help="model size name (models/transformer.MODEL_SIZES)")
+    p.add_argument("--test-model", action="store_true",
+                   help="use the tiny test config instead of --size "
+                        "(vocab 64, 2 layers — the CI smoke's model)")
+    p.add_argument("--loads", nargs="*", type=float, default=[2.0, 5.0],
+                   help="offered loads, poisson requests/s")
+    p.add_argument("--profiles", nargs="*", default=["uniform"],
+                   choices=["uniform", "zipf", "spike"],
+                   help="prompt-length profiles (decode --skew semantics)")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--prompt", type=int, default=64)
+    p.add_argument("--new", type=int, default=32)
+    p.add_argument("--slots", type=int, default=8,
+                   help="fixed decode-batch capacity")
+    p.add_argument("--pages", type=int, default=0,
+                   help="page-pool capacity PER SHARD (0 = sized so half "
+                        "the slots fit max-length requests — a real "
+                        "constraint the scheduler must queue against)")
+    p.add_argument("--page-block", type=int, default=0,
+                   help="KV page size in rows (0 = auto: 8 for the test "
+                        "model, models/decode.PAGE_BLOCK otherwise)")
+    p.add_argument("--slo-ms", type=float, default=500.0,
+                   help="per-token latency SLO for the goodput column")
+    p.add_argument("--dp", type=int, default=0,
+                   help="shard slots over a dp mesh of this size (0 = "
+                        "single device)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="append each completed cell as a JSON line")
+    p.add_argument("--latex", default=None)
+    args = p.parse_args()
+
+    if args.test_model:
+        cfg = TransformerConfig(vocab_size=64, context_length=64,
+                                d_model=64, d_ff=128, num_layers=2,
+                                num_heads=4)
+        args.prompt = min(args.prompt, 16)
+        args.new = min(args.new, cfg.context_length - args.prompt)
+    else:
+        cfg = config_for_size(args.size)
+        if args.prompt + args.new > cfg.context_length:
+            raise SystemExit(
+                f"prompt+new = {args.prompt + args.new} exceeds "
+                f"context_length={cfg.context_length}")
+    if args.page_block <= 0:
+        from cs336_systems_tpu.models.decode import PAGE_BLOCK
+
+        args.page_block = 8 if args.test_model else PAGE_BLOCK
+    per_req = -(-(args.prompt + args.new) // args.page_block)
+    max_blocks = per_req
+    dp = max(args.dp, 1)
+    if args.slots % dp:
+        raise SystemExit(f"--slots {args.slots} not divisible by "
+                         f"--dp {dp}")
+    n_pages = args.pages or max(per_req * (args.slots // dp) // 2, per_req)
+
+    rows = sweep(cfg, args.loads, args.profiles, args.requests,
+                 args.prompt, args.new, args.slots, n_pages, max_blocks,
+                 args.page_block, args.dp, args.seed, args.slo_ms,
+                 args.out)
+    print_table(results_table(rows, latex_path=args.latex))
+
+
+if __name__ == "__main__":
+    main()

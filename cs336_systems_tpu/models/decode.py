@@ -20,7 +20,11 @@ TPU-first design:
   masked by the current length (``iota <= pos``) — no dynamic shapes, no
   recompilation per step.
 - EOS: a scan cannot early-exit, so generation runs to ``max_new_tokens``
-  and the host truncates at the first EOS — same output, fixed cost.
+  steps — but with ``eos_token_id`` set the scan carries a per-row
+  finished mask IN the jit: finished rows stop advancing their cache
+  position (the paged kernel's pos//block early-out then stops paying
+  their KV stream) and the first-EOS step comes back with the tokens, so
+  the host truncation is a slice, not a rescan.
 """
 
 from __future__ import annotations
@@ -120,6 +124,36 @@ def paged_kv_geometry(prompt_lens, max_new_tokens: int,
     return PagedKVGeometry(
         block, int(pages.sum()), nb, tables.astype(np.int32),
         page_rows.astype(np.int32), page_blks.astype(np.int32))
+
+
+def validate_block_tables(tables, n_pages: int) -> None:
+    """Host-side hard check of the reserved-scratch-page contract: every
+    block-table entry must be a REAL page id in [0, n_pages) — page id
+    ``n_pages`` (array index n_pages of the [n_pages + 1]-page pool) is
+    the kernel's write scratch and steering it into a table would let one
+    row's non-final grid flushes overwrite another row's live KV. Called
+    by every table producer (paged_kv_geometry consumers, the serving
+    page-pool allocator) before tables reach a device op; the in-kernel
+    clamp in ops/decode_attention is defensive only and silently corrupts
+    reads, which is exactly why the violation must be caught here."""
+    import numpy as np
+
+    t = np.asarray(tables)
+    if t.size == 0:
+        raise ValueError("block tables must be non-empty")
+    if t.min() < 0:
+        raise ValueError(
+            f"block table contains negative page id {int(t.min())}")
+    if t.max() >= n_pages:
+        where = np.argwhere(t == t.max())[0]
+        if t.max() == n_pages:
+            raise ValueError(
+                f"block table entry {tuple(int(i) for i in where)} is the "
+                f"reserved scratch page id {n_pages} — the scratch page "
+                "must never enter a block table (see init_paged_kv_cache)")
+        raise ValueError(
+            f"block table entry {tuple(int(i) for i in where)} = "
+            f"{int(t.max())} out of range for a {n_pages}-page pool")
 
 
 def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, block: int,
@@ -234,7 +268,8 @@ def _resolve_impl_paged(impl: str, block: int, d: int, itemsize: int) -> str:
 
 
 def _attend_update_xla_paged(q, kv_pool, k_new, v_new, pos, tables,
-                             block: int, window: int | None = None):
+                             block: int, window: int | None = None,
+                             active=None):
     """Portable update+attend on the PAGED pool — the oracle the paged
     Pallas kernel is tested against, and the CPU/fallback serving path.
     Scatters each row's packed new column into its current page, gathers
@@ -245,7 +280,13 @@ def _attend_update_xla_paged(q, kv_pool, k_new, v_new, pos, tables,
     the same value in both layouts and the clamped/duplicate page columns
     are masked to exact softmax zeros. The gather materializes the
     contiguous view (fine for CPU tests); the TPU path is the kernel,
-    which never does."""
+    which never does.
+
+    ``active``: optional [B] mask (serving-engine slot batches) — an
+    inactive row's column write is steered to the pool's reserved scratch
+    page (the LAST pool page, never in any table) so its real pages stay
+    untouched; its attention output is garbage the engine discards. Same
+    semantics as the Pallas kernel's steered write-back tile."""
     from cs336_systems_tpu.ops.attention import attention_with_lse
     from cs336_systems_tpu.ops.decode_attention import pack_kv
 
@@ -253,6 +294,9 @@ def _attend_update_xla_paged(q, kv_pool, k_new, v_new, pos, tables,
     nb = tables.shape[1]
     packed = pack_kv(k_new, v_new)[:, :, 0]  # [B, H, W]
     page = jnp.take_along_axis(tables, (pos // block)[:, None], axis=1)[:, 0]
+    if active is not None:
+        page = jnp.where(jnp.asarray(active, bool), page,
+                         kv_pool.shape[0] - 1)
     row = pos % block
     kv_pool = kv_pool.at[page, :, row, :].set(packed)
     gathered = kv_pool[tables]  # [B, nb, H, block, W]
@@ -279,7 +323,7 @@ def _local_heads(attn_params, cfg: TransformerConfig) -> int:
 def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
                   attend_len: int | None = None, attn_impl: str = "auto",
                   reduce_axis: str | None = None, tables=None,
-                  page_block: int | None = None):
+                  page_block: int | None = None, active=None):
     """One block on a single-token hidden state; returns (x, kv').
 
     ``kv``: this layer's packed [B, H, S, 2*Dh] cache (init_kv_cache).
@@ -302,7 +346,11 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
     The fused paged kernel (or its XLA oracle) streams only each row's
     own pages, so a skewed batch pays sum(ceil(len_i/block)) page reads
     instead of B·max — ``attend_len`` does not apply (the table IS the
-    per-row bound)."""
+    per-row bound).
+
+    ``active``: [B] slot mask (serving engine), paged mode only —
+    inactive rows' KV writes are steered to the pool's scratch page so
+    eviction/join can recycle their pages under the SAME compiled step."""
     b = x.shape[0]
     dh = cfg.d_head
     h = _local_heads(bp["attn"], cfg)
@@ -322,6 +370,10 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
         # checks the inner scope first, so the fused update+attend kernel
         # (and the XLA DUS+softmax fallback) land in kv_update, the
         # projections/rope around it in attn.
+        if active is not None and page_block is None:
+            raise ValueError(
+                "active masks apply to the paged cache only (the steered "
+                "scratch write needs the page pool)")
         if page_block is not None:
             impl = _resolve_impl_paged(attn_impl, page_block, dh,
                                        kv.dtype.itemsize)
@@ -333,12 +385,13 @@ def _decode_block(bp, x, kv, cos, sin, pos, cfg: TransformerConfig,
                 with annotate("kv_update"):
                     attn, kv = paged_decode_attention_update(
                         q, k, v, kv, tables, pos, window=cfg.attn_window,
+                        active=active,
                     )
             else:
                 with annotate("kv_update"):
                     attn, kv = _attend_update_xla_paged(
                         q, kv, k, v, pos, tables, page_block,
-                        cfg.attn_window,
+                        cfg.attn_window, active=active,
                     )
         elif _resolve_impl(attn_impl,
                            attend_len if attend_len is not None
@@ -434,13 +487,18 @@ def _ffn(ffn_params, x, cfg: TransformerConfig):
 def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
                 attend_len: int | None = None, attn_impl: str = "auto",
                 reduce_axis: str | None = None, tables=None,
-                page_block: int | None = None):
+                page_block: int | None = None, active=None):
     """One incremental step: token_ids [B] at position ``pos`` (scalar
     int32, or [B] per-row positions for ragged serving)
     → (logits [B, vocab] fp32, updated cache).
 
     ``page_block``/``tables``: paged-cache mode — ``cache`` holds page
     pools and each row attends only its own pages (see _decode_block).
+    ``active``: [B] slot mask for the serving engine's fixed-capacity
+    slot batch (paged mode only): inactive rows run through the step as
+    dead weight — their KV writes land on the pool's scratch page and
+    their logits are garbage — so join/evict never changes the compiled
+    executable, only host-side tables.
 
     ``attend_len``: static bound on the filled cache length (pos <
     attend_len); attention reads only that prefix — see
@@ -467,6 +525,7 @@ def decode_step(params, cache, pos, token_ids, cfg: TransformerConfig,
         x, kv = _decode_block(
             bp, x, cache["kv"][l], cos, sin, pos, cfg,
             attend_len, attn_impl, reduce_axis, tables, page_block,
+            active,
         )
         kvs.append(kv)
     x = rmsnorm(params["ln_final"], x)
@@ -609,6 +668,28 @@ def prefill(params, prompt_ids, cfg: TransformerConfig, max_len: int | None = No
     return logits, cache, nxt
 
 
+def slot_prefill(params, prompt_ids, cfg: TransformerConfig, prompt_lens,
+                 page_block: int, page_geom, reduce_axis: str | None = None):
+    """Prefill entry point for serving-engine JOINS: run the ragged paged
+    prefill over a join batch and hand back the page contents for the
+    engine to scatter into its long-lived pool.
+
+    ``page_geom`` is the (tables, page_rows, page_blks) triple of a LOCAL
+    throwaway geometry covering only the join batch's prompt blocks (the
+    tables element is unused by prefill and may be None). Returns
+    (last-real-token logits [B, vocab] fp32, per-layer tuple of
+    [n_pages, H, block, 2*Dh] page arrays laid out by that geometry —
+    the local scratch page already dropped — next positions [B] int32).
+    The engine scatters the page arrays at its allocator-assigned ids;
+    row-local numerics make the result independent of how the join batch
+    was composed (pinned by tests/test_serving_engine.py)."""
+    logits, cache, nxt = prefill(
+        params, prompt_ids, cfg, reduce_axis=reduce_axis,
+        prompt_lens=prompt_lens, page_block=page_block, page_geom=page_geom)
+    pages = tuple(kv[:-1] for kv in cache["kv"])  # drop the local scratch
+    return logits, pages, nxt
+
+
 def unstack_blocks(params):
     """Stacked [L, ...]-leaf block params → a tuple of per-layer pytrees.
 
@@ -661,7 +742,11 @@ def _sample(logits, key, temperature: float, top_k: int | None,
     on the batch SHAPE, so a batch-sharded server could never reproduce
     the single-device draws; row-keyed streams depend only on each row's
     global index — what makes sharded serving (parallel/serve.py)
-    bit-identical to the single-device path."""
+    bit-identical to the single-device path. A [B] VECTOR offset gives
+    each row its global index directly (the serving engine's slot
+    batches, where slot order is arbitrary), and ``key`` may then be a
+    [B, 2] PER-ROW key batch (each slot carries its own per-request key
+    chain) — fold_in is vmapped over both."""
     with annotate("sampling"):
         logits = logits / temperature
         if top_k is not None:
@@ -674,8 +759,15 @@ def _sample(logits, key, temperature: float, top_k: int | None,
         if top_p is not None:
             logits = top_p_filter(logits, top_p)
         if row_key_offset is not None:
-            rows = jnp.arange(logits.shape[0], dtype=jnp.int32) + row_key_offset
-            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+            off = jnp.asarray(row_key_offset, jnp.int32)
+            if off.ndim == 1:
+                rows = off  # per-row global indices (engine slot batch)
+            else:
+                rows = jnp.arange(logits.shape[0], dtype=jnp.int32) + off
+            if key.ndim == 2:  # per-row key chains (engine slot batch)
+                keys = jax.vmap(jax.random.fold_in)(key, rows)
+            else:
+                keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
             return jax.vmap(
                 lambda k_, l: jax.random.categorical(k_, l, axis=-1)
             )(keys, logits)
@@ -729,15 +821,31 @@ def _round_up(n: int, m: int) -> int:
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "top_p",
                      "attn_impl", "approx_top_k", "reduce_axis",
-                     "page_block"),
+                     "page_block", "eos_token_id"),
 )
 def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                    temperature, top_k, top_p=None, attn_impl="auto",
                    approx_top_k=False, row_key_offset=None,
                    reduce_axis=None, prompt_lens=None,
-                   page_block=None, page_geom=None):
+                   page_block=None, page_geom=None, eos_token_id=None):
+    # ``eos_token_id`` (static): carry a per-row finished mask through the
+    # scan. A finished row keeps stepping (the scan is static) but its
+    # sampled token is pinned to EOS, and — paged mode — its position
+    # FREEZES, so the paged kernel's pos//block early-out stops streaming
+    # its pages and its writes just re-stamp the EOS column. The return
+    # becomes (tokens [B, T], lengths [B]) where lengths is each row's
+    # first-EOS step (max_new_tokens if none): the EXACT truncation the
+    # host post-hoc scan computed, now a by-product of the scan carry.
+    # Pre-EOS tokens are bit-identical to the eos=None run (the key-split
+    # chain and every live row's compute are unchanged). None keeps the
+    # old single-output contract (serve-family jaxprs unchanged).
+    track_eos = eos_token_id is not None
+    b = prompt_ids.shape[0]
     plen = prompt_ids.shape[1]
     total = plen + max_new_tokens
+    if track_eos:
+        fin0 = jnp.zeros((b,), bool)
+        len0 = jnp.full((b,), max_new_tokens, jnp.int32)
 
     if page_block is not None:
         # PAGED cache: the pool is sized by sum(pages_i) (host geometry,
@@ -755,20 +863,42 @@ def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
                                      page_geom=page_geom)
         params = unstack_blocks(params)
 
-        def body(carry, _):
-            cache, pos, logits, key = carry
+        def body(carry, i):
+            if track_eos:
+                cache, pos, logits, key, fin, flen = carry
+            else:
+                cache, pos, logits, key = carry
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p,
                           approx_top_k, row_key_offset).astype(jnp.int32)
+            if track_eos:
+                nxt = jnp.where(fin, eos_token_id, nxt)
+                just = jnp.logical_and(~fin, nxt == eos_token_id)
+                flen = jnp.where(just, i, flen)
+                fin = fin | just
             new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
                                             None, attn_impl, reduce_axis,
                                             tables, page_block)
+            if track_eos:
+                # freeze finished rows' positions: their page stream stops
+                # growing (real DMA saving through the kernel's early-out)
+                # and their write re-stamps the same column each step
+                pos2 = jnp.where(fin, pos, pos + 1)
+                return (cache, pos2, new_logits, key, fin, flen), nxt
             return (cache, pos + 1, new_logits, key), nxt
 
         carry = (cache, jnp.asarray(pos, jnp.int32), logits, key)
         if max_new_tokens == 0:
-            return jnp.zeros((prompt_ids.shape[0], 0), jnp.int32)
-        _, tokens = jax.lax.scan(body, carry, None, length=max_new_tokens)
+            tokens = jnp.zeros((b, 0), jnp.int32)
+            return (tokens, jnp.zeros((b,), jnp.int32)) if track_eos \
+                else tokens
+        if track_eos:
+            carry = carry + (fin0, len0)
+            final, tokens = jax.lax.scan(
+                body, carry, jnp.arange(max_new_tokens, dtype=jnp.int32))
+            return tokens.T, final[5]  # [B, T], first-EOS steps
+        _, tokens = jax.lax.scan(
+            body, carry, jnp.arange(max_new_tokens, dtype=jnp.int32))
         return tokens.T  # [B, T]
 
     # Right-size the cache to this generation (bucket-rounded): decode is
@@ -783,14 +913,28 @@ def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
     params = unstack_blocks(params)  # loop-invariant per-layer slices
 
     def step(attend_len):
-        def body(carry, _):
-            cache, pos, logits, key = carry
+        def body(carry, i):
+            if track_eos:
+                cache, pos, logits, key, fin, flen = carry
+            else:
+                cache, pos, logits, key = carry
             key, sub = jax.random.split(key)
             nxt = _sample(logits, sub, temperature, top_k, top_p,
                           approx_top_k, row_key_offset).astype(jnp.int32)
+            if track_eos:
+                # the contiguous cache shares one scalar write position
+                # across the batch, so finished rows keep advancing (no
+                # per-row freeze here — that is the paged branch's win);
+                # pinning the fed token to EOS keeps their stream inert
+                nxt = jnp.where(fin, eos_token_id, nxt)
+                just = jnp.logical_and(~fin, nxt == eos_token_id)
+                flen = jnp.where(just, i, flen)
+                fin = fin | just
             new_logits, cache = decode_step(params, cache, pos, nxt, cfg,
                                             attend_len, attn_impl,
                                             reduce_axis)
+            if track_eos:
+                return (cache, pos + 1, new_logits, key, fin, flen), nxt
             return (cache, pos + 1, new_logits, key), nxt
 
         return body
@@ -799,17 +943,24 @@ def _generate_scan(params, prompt_ids, key, cfg, max_new_tokens,
     # prefix: steps i in [i0, i1) write at pos plen+i and read rows
     # [0, plen+i], so a segment may run while plen+i < attend_len.
     carry = (cache, jnp.asarray(pos, jnp.int32), logits, key)
+    if track_eos:
+        carry = carry + (fin0, len0)
     chunks = []
     i = 0
     while i < max_new_tokens:
         attend_len = min(_round_up(plen + i + 1, _ATTEND_BUCKET), alloc)
         seg = min(max_new_tokens - i, attend_len - plen - i)
-        carry, toks = jax.lax.scan(step(attend_len), carry, None, length=seg)
+        carry, toks = jax.lax.scan(
+            step(attend_len), carry,
+            jnp.arange(i, i + seg, dtype=jnp.int32))
         chunks.append(toks)
         i += seg
     if not chunks:  # max_new_tokens == 0: empty generation, as before
-        return jnp.zeros((prompt_ids.shape[0], 0), jnp.int32)
+        tokens = jnp.zeros((b, 0), jnp.int32)
+        return (tokens, jnp.zeros((b,), jnp.int32)) if track_eos else tokens
     tokens = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+    if track_eos:
+        return tokens.T, carry[5]  # [B, T], first-EOS steps
     return tokens.T  # [B, T]
 
 
@@ -859,15 +1010,14 @@ def generate_kv(
             f"exceeds context_length={cfg.context_length}; use generate() "
             "for sliding-window decoding"
         )
-    tokens = _generate_scan(
+    out = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
-        top_p, attn_impl, approx_top_k,
-    )[0]
-    if eos_token_id is not None:
-        hits = jnp.where(tokens == eos_token_id)[0]
-        if hits.size:
-            tokens = tokens[: int(hits[0])]
-    return tokens
+        top_p, attn_impl, approx_top_k, eos_token_id=eos_token_id,
+    )
+    if eos_token_id is None:
+        return out[0]
+    tokens, lengths = out  # in-scan EOS tracking (see _generate_scan)
+    return tokens[0][: int(jax.device_get(lengths)[0])]
 
 
 def generate_kv_batched(
@@ -941,21 +1091,24 @@ def generate_kv_batched(
                    if prompt_lens is not None
                    else np.full((ids.shape[0],), ids.shape[1]))
         geom = paged_kv_geometry(lens_np, max_new_tokens, page_block)
+        validate_block_tables(geom.tables, geom.n_pages)
         page_geom = (jnp.asarray(geom.tables), jnp.asarray(geom.page_rows),
                      jnp.asarray(geom.page_blks))
         if prompt_lens is None:
             prompt_lens = jnp.asarray(lens_np, jnp.int32)
-    tokens = _generate_scan(
+    res = _generate_scan(
         params, ids, key, cfg, max_new_tokens, float(temperature), top_k,
         top_p, attn_impl, approx_top_k,
         row_key_offset=jnp.int32(row_key_offset) if row_keyed else None,
         prompt_lens=prompt_lens,
         page_block=page_block, page_geom=page_geom,
+        eos_token_id=eos_token_id,
     )
     if eos_token_id is None:
-        return tokens
-    out = []
-    for row in jax.device_get(tokens):
-        hits = (row == eos_token_id).nonzero()[0]
-        out.append(row[: int(hits[0])] if hits.size else row)
-    return out
+        return res
+    # in-scan EOS: the scan already tracked each row's first-EOS step
+    # (finished rows stopped paying paged KV streaming) — truncation is a
+    # host slice of the fetched buffer, not a token rescan
+    tokens, lengths = res
+    toks = jax.device_get(tokens)
+    return [row[: int(n)] for row, n in zip(toks, jax.device_get(lengths))]

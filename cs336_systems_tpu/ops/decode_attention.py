@@ -187,10 +187,9 @@ def supported(s: int, d: int, itemsize: int) -> bool:
     return _pick_group(1, s, 2 * d, itemsize, d) is not None
 
 
-def _paged_decode_kernel(pos_ref, tbl_ref, qp_ref, newt_ref, kv_ref,
-                         kvtile_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                         scale: float, window: int | None, block: int,
-                         heads_per_row: int):
+def _paged_decode_kernel(pos_ref, tbl_ref, *refs, scale: float,
+                         window: int | None, block: int,
+                         heads_per_row: int, has_active: bool = False):
     """One grid step of the PAGED decode kernel: G (batch, head) rows of
     ONE batch row against ONE of its [block, W] cache pages, online-
     softmax style (flash_attention's m/l/acc scratch idiom), plus the
@@ -212,7 +211,21 @@ def _paged_decode_kernel(pos_ref, tbl_ref, qp_ref, newt_ref, kv_ref,
     would splat stale VMEM over the real page before j reaches n_last.
     m/l are [G, 8, 128] fp32 scratch (column 0 live, lane-broadcast like
     flash_attention.py's forward); acc is [G, 8, W] fp32.
+
+    ``has_active`` (serving engine): a third scalar-prefetch operand
+    act [B] int32 marks live slot rows; an inactive row's write-back tile
+    is steered to the scratch page by the caller's index map, so its pool
+    pages are never touched (its o output is garbage the engine ignores).
+    The kernel body itself is unchanged — activity only moves the aliased
+    output block — so the active=None lowering is bit-identical to the
+    chip-validated PR 5 kernel.
     """
+    if has_active:
+        (_act_ref, qp_ref, newt_ref, kv_ref, kvtile_ref, o_ref, m_ref,
+         l_ref, acc_ref) = refs
+    else:
+        (qp_ref, newt_ref, kv_ref, kvtile_ref, o_ref, m_ref,
+         l_ref, acc_ref) = refs
     g, _, w = qp_ref.shape
     j = pl.program_id(1)
     pos = pos_ref[(pl.program_id(0) * g) // heads_per_row]
@@ -335,7 +348,8 @@ def paged_attended_kv_bytes(lens, block: int, w: int, itemsize: int) -> int:
 @functools.partial(jax.jit, static_argnames=("window", "interpret"))
 def paged_decode_attention_update(q, k_new, v_new, kv_pool, tables, pos,
                                   window: int | None = None,
-                                  interpret: bool | None = None):
+                                  interpret: bool | None = None,
+                                  active=None):
     """Paged counterpart of ``decode_attention_update``. q, k_new, v_new:
     [B, H, 1, Dh]; kv_pool: [n_pages + 1, H, block, 2*Dh] packed page
     pool whose LAST page is the reserved write scratch (never referenced
@@ -351,6 +365,12 @@ def paged_decode_attention_update(q, k_new, v_new, kv_pool, tables, pos,
     Each grid row streams only ceil((pos_i + 1) / block) pages: the page
     index map clamps at pos_i // block and Mosaic skips the repeated
     fetches. The pool's head axis shards under tp like the cache today.
+
+    ``active``: optional [B] mask (serving-engine slot batches). Inactive
+    rows' write-back tiles are steered to the reserved scratch page so
+    their pool pages stay untouched across the step; their attention
+    output is garbage the engine discards. active=None keeps the exact
+    PR 5 lowering (two scalar-prefetch operands).
     """
     b, h, _, d = q.shape
     n_alloc, hp, block, w = kv_pool.shape
@@ -388,31 +408,35 @@ def paged_decode_attention_update(q, k_new, v_new, kv_pool, tables, pos,
     pos1 = jnp.minimum(jnp.asarray(pos, jnp.int32), nb * block - 1)
     tbl = jnp.minimum(jnp.asarray(tables, jnp.int32).reshape(-1),
                       scratch_page - 1)
+    has_active = active is not None
 
-    def kv_map(r, j, p, t):
+    def kv_map(r, j, p, t, *a):
         bi = (r * g) // h
         jc = jnp.minimum(j, p[bi] // block)
         return (t[bi * nb + jc], r % h_blocks, 0, 0)
 
-    def tile_map(r, j, p, t):
+    def tile_map(r, j, p, t, *a):
         bi = (r * g) // h
         n_last = p[bi] // block
-        page = jnp.where(j == n_last, t[bi * nb + n_last], scratch_page)
-        tile = jnp.where(j == n_last, (p[bi] % block) // 8, 0)
+        on = j == n_last
+        if a:  # steer inactive slot rows' write-back to scratch
+            on = jnp.logical_and(on, a[0][bi] != 0)
+        page = jnp.where(on, t[bi * nb + n_last], scratch_page)
+        tile = jnp.where(on, (p[bi] % block) // 8, 0)
         return (page, r % h_blocks, tile, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3 if has_active else 2,
         grid=(rows // g, nb),
         in_specs=[
-            pl.BlockSpec((g, 8, w), lambda r, j, p, t: (r, 0, 0)),
-            pl.BlockSpec((g, 8, w), lambda r, j, p, t: (r, 0, 0)),
+            pl.BlockSpec((g, 8, w), lambda r, j, p, t, *a: (r, 0, 0)),
+            pl.BlockSpec((g, 8, w), lambda r, j, p, t, *a: (r, 0, 0)),
             pl.BlockSpec((None, g, block, w), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((None, g, 8, w), tile_map),
             # 3-D so the block's trailing dims equal the array's at any g
-            pl.BlockSpec((g, 1, w), lambda r, j, p, t: (r, 0, 0)),
+            pl.BlockSpec((g, 1, w), lambda r, j, p, t, *a: (r, 0, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((g, 8, 128), jnp.float32),
@@ -420,17 +444,27 @@ def paged_decode_attention_update(q, k_new, v_new, kv_pool, tables, pos,
             pltpu.VMEM((g, 8, w), jnp.float32),
         ],
     )
+    if has_active:
+        act = jnp.asarray(active, jnp.int32).reshape(-1)
+        if act.shape[0] != b:
+            raise ValueError(f"active mask rows {act.shape[0]} != batch {b}")
+        operands = (pos1, tbl, act, qp, newt, kv_pool)
+        aliases = {5: 0}  # pool (after pos, tbl, act, qp, newt)
+    else:
+        operands = (pos1, tbl, qp, newt, kv_pool)
+        aliases = {4: 0}  # pool (after pos, tbl, qp, newt)
     kv_out, o = pl.pallas_call(
         functools.partial(_paged_decode_kernel, scale=scale, window=window,
-                          block=block, heads_per_row=h),
+                          block=block, heads_per_row=h,
+                          has_active=has_active),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(kv_pool.shape, kv_pool.dtype),
             jax.ShapeDtypeStruct((rows, 1, w), q.dtype),
         ],
-        input_output_aliases={4: 0},  # pool (after pos, tbl, qp, newt)
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(pos1, tbl, qp, newt, kv_pool)
+    )(*operands)
     o_v = o[:, 0, d:].reshape(b, h, 1, d)  # V half; [0, d) is p.K garbage
     return o_v, kv_out
 

@@ -100,7 +100,8 @@ def serve_param_specs(cfg: TransformerConfig, tp_axis: str | None,
 
 def lint_contract(cfg: TransformerConfig, dp_axis: str | None = None,
                   tp_axis: str | None = None,
-                  ep_axis: str | None = None) -> dict:
+                  ep_axis: str | None = None,
+                  decode_only: bool = False) -> dict:
     """Declared collective contract of ``make_sharded_generate`` for the
     static analysis linter (static call-site counts in the traced
     generation program, L = num_layers):
@@ -125,18 +126,48 @@ def lint_contract(cfg: TransformerConfig, dp_axis: str | None = None,
       body's.
 
     tp and ep compose additively (disjoint axes, disjoint psum sites).
+
+    ``decode_only``: the continuous-batching ENGINE step
+    (serving/engine.make_engine_step) — one unrolled decode step with no
+    prefill in the program (joins prefill through separate programs), so
+    the "+2"/"+1" prefill-body sites drop: tp = 2L, ep = L.
     """
     L = cfg.num_layers
     psum = 0
     if tp_axis is not None:
-        psum += 2 * L + 2
+        psum += 2 * L if decode_only else 2 * L + 2
     if ep_axis is not None:
-        psum += L + 1
+        psum += L if decode_only else L + 1
     return {
         "collectives": {"psum": psum},
-        "note": "serve: dp=0 collectives; tp=2L+2 psums; ep=L+1 psums "
-                "(additive)",
+        "note": ("serve engine step: dp=0 collectives; tp=2L psums; "
+                 "ep=L psums (decode-only, additive)" if decode_only else
+                 "serve: dp=0 collectives; tp=2L+2 psums; ep=L+1 psums "
+                 "(additive)"),
     }
+
+
+def engine_specs(cfg: TransformerConfig, dp_axis: str | None,
+                 tp_axis: str | None, ep_axis: str | None = None):
+    """PartitionSpec triple for the continuous-batching engine's
+    shard_map (serving/engine.py): ``(param_specs, pool_spec,
+    batch_spec)``.
+
+    The engine shards its fixed-capacity SLOT batch over dp — slot s
+    lives on shard s // slots_per_shard, with a SHARD-LOCAL page pool and
+    a shard-local PagePool allocator on the host (pages never cross the
+    mesh, exactly like the cache rows in ``make_sharded_generate``). The
+    pool leaf is [dp · (n_local + 1), H, block, W]: page axis over dp
+    (each shard sees its own n_local + 1 pages, scratch included — page
+    ids in the tables are shard-local), head axis over tp. All per-slot
+    state (tables, positions, active mask, per-slot key chains, logits)
+    shards with the slots over dp and replicates over tp/ep — row-keyed
+    sampling keeps shard-local draws bit-identical to the single-device
+    stream, same argument as the module docstring's dp bullet."""
+    pspecs = serve_param_specs(cfg, tp_axis, ep_axis)
+    batch_spec = P(dp_axis) if dp_axis is not None else P()
+    pool_spec = P(dp_axis, tp_axis)
+    return pspecs, pool_spec, batch_spec
 
 
 def make_sharded_generate(
@@ -308,6 +339,7 @@ def make_sharded_generate(
             from cs336_systems_tpu.models.decode import (
                 _check_prompt_lens,
                 paged_kv_geometry,
+                validate_block_tables,
             )
 
             if prompt_lens is not None:
@@ -342,6 +374,10 @@ def make_sharded_generate(
                 # (valid gather sources, never referenced by any table)
                 prows[k * npl:k * npl + g.n_pages] = g.page_rows
                 pblks[k * npl:k * npl + g.n_pages] = g.page_blks
+            # shard-local page ids against the shard pool's real page
+            # count: the padded pool's scratch sits at index npl, which
+            # must never appear in any shard's table rows
+            validate_block_tables(tables, npl)
             if "paged" not in fns:
                 fns["paged"] = build("paged")
             return fns["paged"](

@@ -1,0 +1,29 @@
+"""Continuous-batching serving engine.
+
+The paged KV cache (models/decode.py, PR 5) made decode-batch rows
+FUNGIBLE: a row's KV lives in pool pages named by a host-side block
+table, so swapping which request occupies a batch slot is a host table
+rewrite, not a device reshuffle. This package is the engine that cashes
+that in — a fixed-capacity slot batch stepped by ONE jit-compiled
+executable, with a free-list page allocator (pool.py), an admission
+queue (scheduler.py), and the join/evict/stream loop (engine.py).
+
+Reference capability re-expressed: the reference (model.py:255-310) has
+no serving loop at all — its generate() runs one fixed batch to a fixed
+step count. The continuous-batching shape follows the vLLM/PagedAttention
+lineage the paged pool was built for (see PAPERS.md: Ragged Paged
+Attention; goodput-under-SLO as the headline metric follows the
+Gemma-on-TPU serving comparison point).
+"""
+
+from cs336_systems_tpu.serving.engine import ServingEngine, make_engine_step
+from cs336_systems_tpu.serving.pool import PagePool
+from cs336_systems_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = [
+    "PagePool",
+    "Request",
+    "Scheduler",
+    "ServingEngine",
+    "make_engine_step",
+]

@@ -1,0 +1,446 @@
+"""Continuous-batching engine: one jit-compiled steady-state decode step
+over a fixed-capacity SLOT batch, with host-side join/evict.
+
+Design (the fungible-row-slot property models/decode.paged_kv_geometry
+was built for):
+
+- The device state is ONE paged KV pool per layer plus a [slots]-shaped
+  decode batch: [slots, max_blocks] block tables, per-slot positions, an
+  active mask, per-slot PRNG key chains, per-slot row indices, and the
+  last logits. Every shape is static, so joining or evicting a request
+  only rewrites HOST tables — the step executable never recompiles.
+- Inactive slots ride through the step as dead weight: the paged
+  attention op steers their KV writes to the pool's reserved scratch
+  page (ops/decode_attention active mask) so a freed slot can never
+  corrupt pages the allocator has already handed to a new request, and
+  their sampled tokens/logits are garbage the host ignores.
+- Joins prefill through SEPARATE per-shape-bucket programs
+  (models/decode.slot_prefill): the join batch runs the ragged paged
+  prefill against a throwaway local geometry, and the resulting page
+  arrays scatter into the long-lived pool at allocator-assigned ids.
+  Bucketed (join_width, prompt_len, page_count) shapes bound the number
+  of compiles.
+- Streams are BIT-IDENTICAL to the row-keyed oracle
+  (generate_kv_batched(..., row_keyed=True, page_block=...)) no matter
+  when a request joins: each slot carries its own PRNG key chain, reset
+  to the engine's base key at join, advanced by one split per decode
+  step — after j emitted tokens the slot's sub-key equals the oracle's
+  step-j sub-key — and sampling folds in the request's GLOBAL row index
+  (models/decode._sample vector row_key_offset). Numerics are row-local
+  (a row's logits depend only on its own tokens — the ragged/paged
+  equivalence tests pin this), so neither the join batch's composition
+  nor the physical page ids perturb a stream.
+- dp/tp meshes (parallel/serve.engine_specs): slots shard over dp with
+  SHARD-LOCAL pools and shard-local PagePool allocators (page ids in the
+  tables are shard-local; no page crosses the mesh); tp shards heads.
+  The decode-only collective contract is serve.lint_contract(...,
+  decode_only=True): dp = 0 psums, tp = 2L.
+
+TPU perf notes (CPU-correct here; open items for the chip, queued in
+results/decode_v5e.txt): per-slot host state is re-uploaded every step
+(~KBs; should become device-resident carries), and the step program
+unstacks the stacked block params per dispatch — the known ~131 us/token
+re-slice cost (unstack_blocks docstring) — acceptable until the engine
+grows a persistent on-device param cache, since unstacking on the host
+would double param HBM.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding
+
+from cs336_systems_tpu.models.decode import (
+    PAGE_BLOCK,
+    _sample,
+    decode_step,
+    slot_prefill,
+    unstack_blocks,
+    validate_block_tables,
+)
+from cs336_systems_tpu.models.transformer import TransformerConfig
+from cs336_systems_tpu.parallel.serve import engine_specs
+from cs336_systems_tpu.parallel.serve import lint_contract as _serve_lint
+from cs336_systems_tpu.serving.pool import PagePool
+from cs336_systems_tpu.serving.scheduler import Request, Scheduler
+
+
+def engine_lint_contract(cfg: TransformerConfig, dp_axis=None, tp_axis=None,
+                         ep_axis=None) -> dict:
+    """Collective contract of ``make_engine_step`` — the decode-only
+    serve contract (no prefill sites in the step program)."""
+    return _serve_lint(cfg, dp_axis, tp_axis, ep_axis, decode_only=True)
+
+
+def make_engine_step(cfg: TransformerConfig, page_block: int,
+                     mesh=None, dp_axis: str | None = None,
+                     tp_axis: str | None = None,
+                     temperature: float = 1.0, top_k: int | None = None,
+                     top_p: float | None = None, attn_impl: str = "auto",
+                     approx_top_k: bool = False, donate: bool = True):
+    """Build the steady-state engine step:
+
+    ``(params, pool, logits, keys, pos, active, row_off, tables) ->
+    (pool, logits, tokens, keys, pos)``
+
+    pool: per-layer tuple of [P, H, block, 2*Dh] page pools (donated —
+    the only multi-MB state); logits [slots, V] fp32 (each slot's last
+    logits); keys [slots, 2] uint32 per-slot PRNG chains; pos/active/
+    row_off [slots] int32; tables [slots, max_blocks] int32.
+
+    One step = sample each slot's next token from its carried logits
+    (per-slot key split + global-row fold_in — the oracle's exact key
+    schedule), then one paged decode step with the active mask. Inactive
+    slots produce garbage tokens/logits and write only to the scratch
+    page. ``donate=False`` for analysis tracing (tracekit re-runs the
+    same bundle)."""
+    temperature = float(temperature)
+
+    def local(params, pool, logits, keys, pos, active, row_off, tables):
+        params = unstack_blocks(params)
+        ks = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
+        keys2, subs = ks[:, 0], ks[:, 1]
+        nxt = _sample(logits, subs, temperature, top_k, top_p,
+                      approx_top_k, row_key_offset=row_off).astype(jnp.int32)
+        new_logits, cache = decode_step(
+            params, {"kv": pool}, pos, nxt, cfg, None, attn_impl,
+            tp_axis, tables, page_block, active)
+        pos2 = jnp.where(active != 0, pos + 1, pos)
+        return cache["kv"], new_logits, nxt, keys2, pos2
+
+    donate_args = (1,) if donate else ()
+    if mesh is None:
+        return jax.jit(local, donate_argnums=donate_args)
+    pspecs, pool_spec, batch_spec = engine_specs(cfg, dp_axis, tp_axis)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(pspecs, pool_spec, batch_spec, batch_spec, batch_spec,
+                  batch_spec, batch_spec, batch_spec),
+        out_specs=(pool_spec, batch_spec, batch_spec, batch_spec,
+                   batch_spec),
+        check_vma=False,  # same argument as make_sharded_generate: the
+        # slot state is tp-replicated by construction (psum'd activations
+        # + per-slot keys); the strict checker cannot prove it
+    )
+    return jax.jit(fn, donate_argnums=donate_args)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class ServingEngine:
+    """Continuous-batching serving: submit ``Request``s, step the slot
+    batch, stream tokens back per request.
+
+    ``slots``: fixed decode-batch capacity (divisible by the dp degree);
+    ``n_pages``: PER-SHARD page-pool capacity; ``max_blocks``: table
+    width — the per-request page-count ceiling. ``key``: base PRNG key;
+    a request's stream equals ``generate_kv_batched(..., key=key,
+    row_keyed=True, row_key_offset=row, page_block=...)`` on its row.
+    ``eos_token_id``: a slot sampling EOS finishes WITHOUT emitting it
+    (the oracle's truncation excludes the EOS token) and its pages free
+    immediately. ``clock``: callable for arrival/latency timestamps
+    (benchmarks pass time.monotonic; tests drive virtual time through
+    ``step(now)``/``run(time_fn)``)."""
+
+    def __init__(self, params, cfg: TransformerConfig, *, key,
+                 slots: int, n_pages: int, max_blocks: int,
+                 page_block: int = PAGE_BLOCK,
+                 temperature: float = 1.0, top_k: int | None = None,
+                 top_p: float | None = None,
+                 eos_token_id: int | None = None,
+                 attn_impl: str = "auto", approx_top_k: bool = False,
+                 mesh=None, dp_axis: str | None = None,
+                 tp_axis: str | None = None,
+                 clock=None, on_token=None):
+        if page_block <= 0 or page_block % 8:
+            raise ValueError(
+                f"page block must be a positive multiple of 8, "
+                f"got {page_block}")
+        dp = 1
+        if mesh is not None:
+            for name, ax in (("dp_axis", dp_axis), ("tp_axis", tp_axis)):
+                if ax is not None and ax not in mesh.shape:
+                    raise ValueError(f"{name}={ax!r} not in mesh "
+                                     f"{dict(mesh.shape)}")
+            if dp_axis is not None:
+                dp = mesh.shape[dp_axis]
+            if tp_axis is not None and cfg.num_heads % mesh.shape[tp_axis]:
+                raise ValueError(
+                    f"num_heads={cfg.num_heads} must divide by "
+                    f"{tp_axis}={mesh.shape[tp_axis]}")
+        if slots % dp:
+            raise ValueError(f"slots={slots} not divisible by dp={dp}")
+        self.cfg = cfg
+        self.params = params
+        self.page_block = page_block
+        self.slots, self.n_pages, self.max_blocks = slots, n_pages, max_blocks
+        self.mesh, self.dp_axis, self.tp_axis = mesh, dp_axis, tp_axis
+        self.dp, self.slots_per = dp, slots // dp
+        self.eos_token_id = eos_token_id
+        self.clock, self.on_token = clock, on_token
+        self.base_key = np.asarray(jax.device_get(key), np.uint32).reshape(2)
+
+        # shard-local allocators — page ids in the tables are shard-local
+        self.pools = [PagePool(n_pages) for _ in range(dp)]
+        self.scheduler = Scheduler()
+        self.running: dict[int, Request] = {}
+        self.results: dict[int, np.ndarray] = {}
+        self.steps = 0
+
+        # host-side slot state, re-uploaded per step (see module note)
+        self.tables = np.zeros((slots, max_blocks), np.int32)
+        self.pos = np.zeros((slots,), np.int32)
+        self.active = np.zeros((slots,), np.int32)
+        self.keys = np.zeros((slots, 2), np.uint32)
+        self.row_off = np.zeros((slots,), np.int32)
+        self.logits = np.zeros((slots, cfg.vocab_size), np.float32)
+
+        # device pool: dp shard-pools stacked on the page axis, each with
+        # its own scratch page at local index n_pages
+        shape = (dp * (n_pages + 1), cfg.num_heads, page_block,
+                 2 * cfg.d_head)
+        pool = tuple(jnp.zeros(shape, cfg.cdtype)
+                     for _ in range(cfg.num_layers))
+        if mesh is not None:
+            _, pool_spec, _ = engine_specs(cfg, dp_axis, tp_axis)
+            sh = NamedSharding(mesh, pool_spec)
+            pool = tuple(jax.device_put(x, sh) for x in pool)
+        self._pool = pool
+
+        self._step_fn = make_engine_step(
+            cfg, page_block, mesh=mesh, dp_axis=dp_axis, tp_axis=tp_axis,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            attn_impl=attn_impl, approx_top_k=approx_top_k)
+        self._pf_cache = {}
+
+    # -- admission ---------------------------------------------------
+
+    def _pages_needed(self, req: Request) -> int:
+        return -(-(req.prompt.size + req.max_new_tokens) // self.page_block)
+
+    def submit(self, req: Request) -> None:
+        if req.prompt.size + req.max_new_tokens > self.cfg.context_length:
+            raise ValueError(
+                f"request {req.rid}: prompt ({req.prompt.size}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"context_length={self.cfg.context_length}")
+        npg = self._pages_needed(req)
+        if npg > self.n_pages:
+            raise ValueError(
+                f"request {req.rid} needs {npg} pages; the shard pool has "
+                f"{self.n_pages} — it could never be admitted")
+        if npg > self.max_blocks:
+            raise ValueError(
+                f"request {req.rid} needs {npg} blocks; tables are "
+                f"{self.max_blocks} wide")
+        self.scheduler.submit(req)
+
+    def _admit(self, now: float) -> int:
+        """Strict-FIFO join: the head request takes the lowest free slot
+        whose shard allocator can hold its pages; if none can, it BLOCKS
+        (nothing behind it bypasses) until an eviction frees capacity."""
+        joins = []
+        while True:
+            req = self.scheduler.head(now)
+            if req is None:
+                break
+            npg = self._pages_needed(req)
+            slot = None
+            for s in range(self.slots):
+                if s in self.running:
+                    continue
+                if self.pools[s // self.slots_per].available >= npg:
+                    slot = s
+                    break
+            if slot is None:
+                break
+            self.scheduler.pop()
+            pages = self.pools[slot // self.slots_per].alloc(npg, req.rid)
+            self.running[slot] = req
+            joins.append((slot, req, pages))
+        if joins:
+            self._prefill_joins(joins)
+        return len(joins)
+
+    # -- prefill-into-pool -------------------------------------------
+
+    def _prefill_fn(self, jw: int, plen: int, npg: int):
+        cache_key = (jw, plen, npg)
+        fn = self._pf_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        cfg, blk, tp = self.cfg, self.page_block, self.tp_axis
+
+        def local(params, pool, ids, lens, prows, pblks, dest):
+            logits, pages, _ = slot_prefill(
+                params, ids, cfg, lens, blk, (None, prows, pblks),
+                reduce_axis=tp)
+            pool = tuple(x.at[dest].set(pg) for x, pg in zip(pool, pages))
+            return logits, pool
+
+        if self.mesh is None:
+            fn = jax.jit(local, donate_argnums=(1,))
+        else:
+            pspecs, pool_spec, batch_spec = engine_specs(
+                cfg, self.dp_axis, tp)
+            fn = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(pspecs, pool_spec, batch_spec, batch_spec,
+                          batch_spec, batch_spec, batch_spec),
+                out_specs=(batch_spec, pool_spec),
+                check_vma=False), donate_argnums=(1,))
+        self._pf_cache[cache_key] = fn
+        return fn
+
+    def _prefill_joins(self, joins) -> None:
+        """Prefill the join batch and scatter its pages into the pool.
+
+        Shapes are bucketed — join width to a power of two, prompt width
+        to a multiple of 8, page count to a power of two — so repeated
+        joins reuse a handful of compiled programs. Padding rows carry a
+        1-token dummy prompt and padding geometry entries scatter to the
+        shard's LOCAL scratch page (id n_pages — never in a table), so
+        junk K/V never lands on allocated pages. Row-local numerics make
+        each request's prefill bit-equal to the oracle's regardless of
+        the join batch around it."""
+        blk, dp, npages = self.page_block, self.dp, self.n_pages
+        per_shard = [[] for _ in range(dp)]
+        for slot, req, pages in joins:
+            per_shard[slot // self.slots_per].append((slot, req, pages))
+        jw = _pow2(max(len(v) for v in per_shard))
+        plen = -(-max(req.prompt.size for _, req, _ in joins) // 8) * 8
+        npg = _pow2(max(
+            max((sum(-(-req.prompt.size // blk) for _, req, _ in v)
+                 for v in per_shard if v), default=1), 1))
+
+        ids = np.zeros((dp * jw, plen), np.int32)
+        lens = np.ones((dp * jw,), np.int32)  # dummy rows: 1 pad token
+        prows = np.zeros((dp * npg,), np.int32)
+        pblks = np.zeros((dp * npg,), np.int32)
+        dest = np.full((dp * npg,), npages, np.int32)  # default: scratch
+        for k, v in enumerate(per_shard):
+            o = 0
+            for r, (slot, req, pages) in enumerate(v):
+                ln = req.prompt.size
+                ids[k * jw + r, :ln] = req.prompt
+                lens[k * jw + r] = ln
+                nbp = -(-ln // blk)  # prompt blocks only; growth pages
+                # start with stale/zero data decode overwrites pre-attend
+                prows[k * npg + o:k * npg + o + nbp] = r
+                pblks[k * npg + o:k * npg + o + nbp] = np.arange(nbp)
+                dest[k * npg + o:k * npg + o + nbp] = pages[:nbp]
+                o += nbp
+
+        fn = self._prefill_fn(jw, plen, npg)
+        logits, self._pool = fn(self.params, self._pool, jnp.asarray(ids),
+                                jnp.asarray(lens), jnp.asarray(prows),
+                                jnp.asarray(pblks), jnp.asarray(dest))
+        lg = np.asarray(jax.device_get(logits))
+        for k, v in enumerate(per_shard):
+            for r, (slot, req, pages) in enumerate(v):
+                self.logits[slot] = lg[k * jw + r]
+                self.pos[slot] = req.prompt.size
+                self.active[slot] = 1
+                self.keys[slot] = self.base_key  # fresh per-slot chain
+                self.row_off[slot] = req.row
+                self.tables[slot] = (pages
+                                     + [pages[-1]]
+                                     * (self.max_blocks - len(pages)))
+        # the scratch-never-in-a-table contract, checked on every join
+        validate_block_tables(self.tables, self.n_pages)
+
+    # -- the steady-state step ---------------------------------------
+
+    def _finish(self, slot: int, req: Request, when: float) -> None:
+        self.pools[slot // self.slots_per].free(req.rid)
+        self.active[slot] = 0
+        del self.running[slot]
+        req.finish_time = when
+        self.results[req.rid] = np.asarray(req.tokens, np.int32)
+
+    def step(self, now: float | None = None) -> list:
+        """Admit what has arrived by ``now``, run ONE decode step over
+        the slot batch, emit/evict. Returns [(rid, token-or-None)]
+        events (None = finished at EOS without emitting)."""
+        if now is None:
+            now = self.clock() if self.clock is not None else math.inf
+        self._admit(now)
+        if not self.running:
+            return []
+        out = self._step_fn(
+            self.params, self._pool, jnp.asarray(self.logits),
+            jnp.asarray(self.keys), jnp.asarray(self.pos),
+            jnp.asarray(self.active), jnp.asarray(self.row_off),
+            jnp.asarray(self.tables))
+        self._pool = out[0]
+        logits, toks, keys, pos = jax.device_get(out[1:])
+        # device_get hands back read-only arrays; joins mutate these
+        self.logits, self.keys, self.pos = (
+            np.array(logits), np.array(keys), np.array(pos))
+        self.steps += 1
+
+        emit_t = self.clock() if self.clock is not None else now
+        events = []
+        for slot in sorted(self.running):
+            req = self.running[slot]
+            t = int(toks[slot])
+            if self.eos_token_id is not None and t == self.eos_token_id:
+                # the oracle's truncation EXCLUDES the EOS token
+                self._finish(slot, req, emit_t)
+                events.append((req.rid, None))
+                continue
+            req.tokens.append(t)
+            req.emit_times.append(emit_t)
+            if self.on_token is not None:
+                self.on_token(req.rid, t)
+            events.append((req.rid, t))
+            if len(req.tokens) >= req.max_new_tokens:
+                self._finish(slot, req, emit_t)
+        return events
+
+    def run(self, time_fn=None) -> dict[int, np.ndarray]:
+        """Drive steps until every submitted request completes; returns
+        {rid: tokens}. ``time_fn``: virtual clock for tests (the engine
+        fast-forwards an idle batch to the next arrival); without it the
+        engine's ``clock`` (wall time) or "everything already arrived"
+        (math.inf) applies."""
+        while len(self.scheduler) or self.running:
+            if time_fn is not None:
+                now = time_fn()
+            elif self.clock is not None:
+                now = self.clock()
+            else:
+                now = math.inf
+            if not self.running and self.scheduler.head(now) is None:
+                nxt = self.scheduler.next_arrival()
+                if self.clock is not None and time_fn is None:
+                    _time.sleep(min(max(nxt - now, 0.0), 0.05))
+                    continue
+                now = nxt  # virtual clock: jump to the next arrival
+            self.step(now)
+        return self.results
+
+    # -- invariants ---------------------------------------------------
+
+    def check_idle(self) -> None:
+        """Drained-engine invariant (the CI smoke's leak gate): no
+        running requests and every shard pool fully free."""
+        if self.running:
+            raise AssertionError(f"requests still running: "
+                                 f"{sorted(r.rid for r in self.running.values())}")
+        for k, p in enumerate(self.pools):
+            try:
+                p.check_all_free()
+            except AssertionError as e:
+                raise AssertionError(f"shard {k}: {e}") from None

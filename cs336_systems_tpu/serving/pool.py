@@ -1,0 +1,97 @@
+"""Page-pool allocator: a host-side free list over the physical page ids
+of one paged KV pool (models/decode.init_paged_kv_cache).
+
+The pool array has ``n_pages + 1`` pages; index ``n_pages`` is the
+kernel's reserved write scratch and is PERMANENTLY excluded here — it is
+not in the free list at construction, ``alloc`` can never hand it out,
+and ``free`` rejects it — so a block table built from this allocator's
+ids satisfies models/decode.validate_block_tables by construction.
+
+Owner tracking is per request id: ``alloc(n, owner)`` binds n pages to
+the owner, ``free(owner)`` returns ALL of them at once (a finished
+request's pages come back in one move — the eviction contract), and
+``check_conserved()`` asserts the free list + owned sets partition the
+full page range, which is the leak check the CI smoke and every
+benchmark trace run after draining (ISSUE 8 acceptance criterion).
+"""
+
+from __future__ import annotations
+
+
+class PagePool:
+    """Free-list allocator over page ids [0, n_pages) of one pool array.
+
+    LIFO free list: freshly freed pages are reused first, which keeps the
+    touched working set small and makes allocation order deterministic —
+    the engine's bit-exactness across join orders does NOT depend on
+    which physical ids a request gets (row-local numerics), but
+    determinism keeps failures reproducible.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"pool needs at least one real page, got {n_pages}")
+        self.n_pages = n_pages
+        self.scratch_page = n_pages  # array index of the reserved page
+        self._free: list[int] = list(range(n_pages - 1, -1, -1))
+        self._owned: dict[object, list[int]] = {}
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def owned_by(self, owner) -> list[int]:
+        """The owner's pages, in block order (a copy)."""
+        return list(self._owned[owner])
+
+    def alloc(self, n: int, owner) -> list[int]:
+        """Take ``n`` pages for ``owner``; returns them in block order.
+        All-or-nothing: raises without touching the free list when the
+        pool cannot satisfy the request (the scheduler then leaves the
+        request queued until an eviction frees enough pages)."""
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if owner in self._owned:
+            raise ValueError(f"owner {owner!r} already holds pages "
+                             f"{self._owned[owner]} (double alloc)")
+        if n > len(self._free):
+            raise MemoryError(
+                f"pool exhausted: {n} pages requested, "
+                f"{len(self._free)} free of {self.n_pages}")
+        pages = [self._free.pop() for _ in range(n)]
+        assert self.scratch_page not in pages  # excluded at construction
+        self._owned[owner] = pages
+        return list(pages)
+
+    def free(self, owner) -> int:
+        """Return ALL of ``owner``'s pages to the free list; returns the
+        count. Raises on unknown owner (double free)."""
+        if owner not in self._owned:
+            raise KeyError(f"owner {owner!r} holds no pages (double free?)")
+        pages = self._owned.pop(owner)
+        self._free.extend(pages)
+        return len(pages)
+
+    def check_conserved(self) -> None:
+        """Assert the free list and the owned sets exactly partition
+        [0, n_pages) — no leak, no duplication, no scratch intrusion."""
+        seen = list(self._free)
+        for pages in self._owned.values():
+            seen.extend(pages)
+        if len(seen) != len(set(seen)):
+            raise AssertionError("page id duplicated across free/owned sets")
+        if set(seen) != set(range(self.n_pages)):
+            missing = set(range(self.n_pages)) - set(seen)
+            extra = set(seen) - set(range(self.n_pages))
+            raise AssertionError(
+                f"pool not conserved: leaked={sorted(missing)} "
+                f"foreign={sorted(extra)}")
+
+    def check_all_free(self) -> None:
+        """Assert every page is back in the free list (a drained engine):
+        the CI smoke's no-leak gate."""
+        self.check_conserved()
+        if self._owned:
+            raise AssertionError(
+                f"pages still owned after drain: "
+                f"{ {k: v for k, v in self._owned.items()} }")

@@ -1,0 +1,76 @@
+"""Request admission queue for the continuous-batching engine.
+
+Requests carry arrival timestamps (the benchmark's poisson clock; tests
+use a virtual step counter) and join the decode batch strictly in
+arrival order: the head request waits until a slot AND its pages are
+free, and nothing behind it may bypass it — FIFO admission keeps the
+engine's stream assignment a pure function of the request set, which is
+what the bit-exactness-across-join-orders test leans on (every request's
+tokens depend only on its OWN row key chain, never on when it joined).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``row``: the request's global row index in the row-keyed sampling
+    stream (models/decode._sample) — the engine reproduces
+    ``generate_kv_batched(..., row_keyed=True)`` row ``row`` bit-for-bit
+    regardless of which slot serves it. Defaults to ``rid``.
+    """
+
+    rid: int
+    prompt: object            # 1-D int32 token ids (host numpy/list)
+    max_new_tokens: int
+    arrival: float = 0.0
+    row: int | None = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid}: max_new_tokens must be >= 1")
+        if self.row is None:
+            self.row = self.rid
+        self.tokens: list[int] = []      # emitted stream
+        self.finish_time: float | None = None
+        self.emit_times: list[float] = []  # benchmark latency samples
+
+
+class Scheduler:
+    """FIFO admission queue keyed by (arrival, submission order)."""
+
+    def __init__(self):
+        self._queue: list[tuple[float, int, Request]] = []
+        self._seq = itertools.count()
+
+    def submit(self, req: Request) -> None:
+        self._queue.append((float(req.arrival), next(self._seq), req))
+        self._queue.sort(key=lambda t: (t[0], t[1]))
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def head(self, now: float) -> Request | None:
+        """The next admissible request (arrived by ``now``), without
+        removing it — the engine pops only once slot + pages are found."""
+        if self._queue and self._queue[0][0] <= now:
+            return self._queue[0][2]
+        return None
+
+    def pop(self) -> Request:
+        return self._queue.pop(0)[2]
+
+    def next_arrival(self) -> float | None:
+        """Earliest queued arrival time (for the benchmark's idle wait)."""
+        return self._queue[0][0] if self._queue else None

@@ -67,6 +67,32 @@ if [ "$paged_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$paged_status
 
+# serve-engine gate: the continuous-batching engine's steady-state step
+# through both analysis pipelines (the trace must carry all four serve
+# phase scopes; the memprofile must attribute the page pool under the
+# kv-cache class inside the declared budget), then a tiny poisson smoke
+# through the REAL engine loop — all requests must complete and the page
+# allocator must end fully free (ServingEngine.check_idle raises on a
+# leaked page, which fails the cell and this gate).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.trace_cli --step serve_engine \
+    --iters 1 --out /tmp/engine_smoke.stepprofile.json
+engine_status=$?
+if [ "$engine_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.mem_cli --step serve_engine \
+        --out /tmp/engine_smoke.memprofile.json
+    engine_status=$?
+fi
+if [ "$engine_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.benchmarks.serving --test-model \
+        --requests 10 --loads 20 --new 6 --profiles uniform zipf spike \
+        --out /tmp/engine_smoke.jsonl
+    engine_status=$?
+fi
+[ "$status" -eq 0 ] && status=$engine_status
+
 # gradsan gate: the differential numerics sanitizer on the two composed
 # families whose parity regression it root-caused (the a2a grad sync and
 # the sp/dp flat sync — parallel/ep.py, parallel/sp.py): the sharded

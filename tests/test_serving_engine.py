@@ -1,0 +1,279 @@
+"""Continuous-batching engine tests: the page-pool allocator's
+conservation invariants, the scratch-page table contract, in-scan EOS
+tracking, and the headline property — the engine's per-request streams
+are BIT-IDENTICAL to the row-keyed oracle
+(``generate_kv_batched(..., row_keyed=True, page_block=...)``) no matter
+when requests arrive, in what order they join, how few slots exist, or
+how the slots shard over a dp/tp mesh. Same oracle discipline as
+tests/test_serve.py: continuous batching is a SCHEDULE, not an
+approximation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.models.decode import (
+    generate_kv_batched,
+    validate_block_tables,
+)
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.serving import PagePool, Request, Scheduler, ServingEngine
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 10
+LENS = [12, 3, 7, 1, 12, 5, 9, 2]  # test_paged_decode's skew profile
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+            for n in LENS]
+
+
+def _oracle(params, prompts, eos=None):
+    """All rows in ONE row-keyed paged batch — the stream the engine must
+    reproduce per request regardless of its serving schedule."""
+    pmax = max(p.size for p in prompts)
+    padded = np.zeros((len(prompts), pmax), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :p.size] = p
+    return generate_kv_batched(
+        params, CFG, padded, NEW, jax.random.PRNGKey(0), temperature=0.9,
+        top_k=8, row_keyed=True, prompt_lens=[p.size for p in prompts],
+        page_block=BLK, eos_token_id=eos)
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=8, n_pages=32,
+                max_blocks=4, page_block=BLK, temperature=0.9, top_k=8)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+# --- page-pool allocator ----------------------------------------------
+
+
+class TestPagePool:
+    def test_alloc_free_conserves(self):
+        pool = PagePool(8)
+        a = pool.alloc(3, "a")
+        b = pool.alloc(4, "b")
+        assert len(set(a) | set(b)) == 7 and pool.available == 1
+        pool.check_conserved()
+        assert pool.free("a") == 3
+        pool.check_conserved()
+        pool.free("b")
+        pool.check_all_free()
+
+    def test_scratch_never_allocated(self):
+        pool = PagePool(4)
+        pages = pool.alloc(4, "all")
+        assert pool.scratch_page == 4 and 4 not in pages
+        assert sorted(pages) == [0, 1, 2, 3]
+
+    def test_exhaustion_is_all_or_nothing(self):
+        pool = PagePool(4)
+        pool.alloc(3, "a")
+        with pytest.raises(MemoryError):
+            pool.alloc(2, "b")
+        assert pool.available == 1  # the failed alloc took nothing
+        pool.check_conserved()
+
+    def test_double_alloc_and_double_free_raise(self):
+        pool = PagePool(4)
+        pool.alloc(1, "a")
+        with pytest.raises(ValueError):
+            pool.alloc(1, "a")
+        pool.free("a")
+        with pytest.raises(KeyError):
+            pool.free("a")
+
+    def test_leak_detection(self):
+        pool = PagePool(4)
+        pool.alloc(2, "a")
+        pool._owned["a"].pop()  # corrupt: drop a page on the floor
+        with pytest.raises(AssertionError, match="leaked"):
+            pool.check_conserved()
+
+
+# --- the scratch-page table contract (satellite: validate_block_tables) -
+
+
+def test_validate_block_tables_rejects_scratch_id():
+    good = np.array([[0, 1], [2, 2]], np.int32)
+    validate_block_tables(good, n_pages=4)
+    bad = good.copy()
+    bad[1, 1] = 4  # the reserved scratch page id
+    with pytest.raises(ValueError, match="scratch"):
+        validate_block_tables(bad, n_pages=4)
+    with pytest.raises(ValueError):
+        validate_block_tables(np.array([[5]], np.int32), n_pages=4)
+    with pytest.raises(ValueError):
+        validate_block_tables(np.array([[-1]], np.int32), n_pages=4)
+
+
+def test_generate_kv_batched_validates_corrupt_geometry(params, prompts):
+    """The consumer-side check: a geometry whose table smuggles the
+    scratch id must be rejected before any kernel sees it."""
+    import dataclasses
+
+    from cs336_systems_tpu.models import decode as D
+
+    orig = D.paged_kv_geometry
+
+    def corrupt(*a, **kw):
+        g = orig(*a, **kw)
+        tables = np.array(g.tables)
+        tables[0, 0] = g.n_pages  # scratch id into a live table
+        return dataclasses.replace(g, tables=tables)
+
+    D.paged_kv_geometry = corrupt
+    try:
+        with pytest.raises(ValueError, match="scratch"):
+            _oracle(params, prompts)
+    finally:
+        D.paged_kv_geometry = orig
+
+
+# --- FIFO scheduler ----------------------------------------------------
+
+
+def test_scheduler_fifo_by_arrival_then_submission():
+    s = Scheduler()
+    s.submit(Request(rid=1, prompt=[1], max_new_tokens=1, arrival=2.0))
+    s.submit(Request(rid=2, prompt=[1], max_new_tokens=1, arrival=1.0))
+    s.submit(Request(rid=3, prompt=[1], max_new_tokens=1, arrival=1.0))
+    assert s.head(0.5) is None          # nothing has arrived yet
+    assert s.head(1.0).rid == 2         # earliest arrival wins
+    assert s.pop().rid == 2
+    assert s.head(1.0).rid == 3         # ties break by submission order
+    assert s.pop().rid == 3
+    assert s.next_arrival() == 2.0
+
+
+# --- in-scan EOS tracking (satellite: generate_kv_batched) -------------
+
+
+def test_in_scan_eos_matches_host_truncation(params, prompts):
+    """The in-scan finished-mask must reproduce exactly what the old
+    host-side post-hoc truncation computed: cut at the first EOS,
+    excluding the EOS token itself."""
+    full = np.asarray(_oracle(params, prompts))
+    eos = int(full[0][3])  # appears mid-stream in row 0
+    got = _oracle(params, prompts, eos=eos)
+    for row in range(len(prompts)):
+        hits = np.where(full[row] == eos)[0]
+        want = full[row][: hits[0]] if hits.size else full[row]
+        np.testing.assert_array_equal(np.asarray(got[row]), want)
+
+
+# --- engine vs oracle: the bit-exactness contract ----------------------
+
+
+def test_engine_matches_oracle_all_at_once(params, prompts):
+    want = np.asarray(_oracle(params, prompts))
+    eng = _engine(params)
+    for r, p in enumerate(prompts):
+        eng.submit(Request(rid=r, prompt=p, max_new_tokens=NEW))
+    res = eng.run()
+    eng.check_idle()  # every page back in the free list
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+@pytest.mark.parametrize("order", [
+    [5, 2, 7, 0, 3, 6, 1, 4],
+    [7, 6, 5, 4, 3, 2, 1, 0],
+], ids=["shuffled", "reversed"])
+def test_engine_matches_oracle_across_join_orders(params, prompts, order):
+    """Half the slots, staggered arrivals in permuted orders: requests
+    queue, join mid-flight into slots vacated by earlier evictions — and
+    every stream still equals the oracle's row (the per-slot key chain +
+    global-row fold_in make the stream a function of the request alone)."""
+    want = np.asarray(_oracle(params, prompts))
+    eng = _engine(params, slots=4, n_pages=16)
+    for i, r in enumerate(order):
+        eng.submit(Request(rid=r, prompt=prompts[r], max_new_tokens=NEW,
+                           arrival=float(i) * 0.25))
+    tick = iter(np.arange(0.0, 1e4, 0.5))
+    res = eng.run(time_fn=lambda: next(tick))
+    eng.check_idle()
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+def test_engine_eos_eviction_matches_oracle(params, prompts):
+    """A slot sampling EOS finishes without emitting it and its pages
+    free immediately — streams equal the oracle's truncated rows."""
+    full = np.asarray(_oracle(params, prompts))
+    eos = int(full[0][3])
+    want = _oracle(params, prompts, eos=eos)
+    eng = _engine(params, eos_token_id=eos)
+    for r, p in enumerate(prompts):
+        eng.submit(Request(rid=r, prompt=p, max_new_tokens=NEW))
+    res = eng.run()
+    eng.check_idle()
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], np.asarray(want[r]))
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+], ids=["dp8", "dp2xtp4"])
+def test_engine_matches_oracle_on_mesh(params, prompts, mesh_axes, dp, tp):
+    """Sharded slots (shard-local pools and allocators), staggered
+    shuffled arrivals: still bit-identical to the single-device oracle."""
+    want = np.asarray(_oracle(params, prompts))
+    eng = _engine(params, slots=8, n_pages=8,
+                  mesh=make_mesh(mesh_axes), dp_axis=dp, tp_axis=tp)
+    for i, r in enumerate([4, 1, 6, 0, 7, 2, 5, 3]):
+        eng.submit(Request(rid=r, prompt=prompts[r], max_new_tokens=NEW,
+                           arrival=float(i) * 0.25))
+    tick = iter(np.arange(0.0, 1e4, 0.5))
+    res = eng.run(time_fn=lambda: next(tick))
+    eng.check_idle()
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+def test_engine_strict_fifo_blocks_head(params, prompts):
+    """A head request too big for the CURRENT free pages blocks admission
+    — nothing behind it bypasses — until an eviction frees capacity;
+    every request still completes with its oracle stream."""
+    want = np.asarray(_oracle(params, prompts))
+    # 3 pages: one 12-token request (2 pages incl. growth) + one 1-token
+    # request fill the pool; everything else must wait for evictions
+    eng = _engine(params, slots=2, n_pages=3, max_blocks=3)
+    for r in range(len(prompts)):
+        eng.submit(Request(rid=r, prompt=prompts[r], max_new_tokens=NEW))
+    res = eng.run()
+    eng.check_idle()
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+def test_engine_rejects_impossible_requests(params):
+    eng = _engine(params, n_pages=2, max_blocks=2)
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit(Request(rid=0, prompt=np.zeros(17, np.int32),
+                           max_new_tokens=8))  # 4 pages > pool's 2
+    with pytest.raises(ValueError, match="context_length"):
+        eng.submit(Request(rid=1, prompt=np.zeros(8, np.int32),
+                           max_new_tokens=CFG.context_length))
