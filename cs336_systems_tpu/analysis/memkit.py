@@ -8,7 +8,7 @@ finding hinged on memory (training b48 OOMs under gmm because of the h/g
 residuals, ctx-65536 needs ``--remat`` or it stashes 25 GB, the fused
 flash backward lives or dies on a 16M/18.3M VMEM boundary; BASELINE.md).
 
-What it does, per registered step family (the same 16 train/serve
+What it does, per registered step family (the same 17 train/serve
 families tracekit drives, plus the headline/decode/MoE bench shapes):
 
 - lowers the step over its (tiny or abstract) inputs and compiles it,
@@ -70,10 +70,12 @@ SCHEMA = "memprofile/v1"
 # Buffer classes reported in memprofile composition tables. "output" is
 # the entry-output reservation: for donate=False registry steps it holds
 # the updated params/opt-state copies (donated steps fold it into the
-# param buffers via input_output_alias and it goes to ~0).
+# param buffers via input_output_alias and it goes to ~0). The engine
+# families further split "kv-cache" into "kv-shared"/"kv-private"
+# (SERVE_KV_SPLIT below): shared prefix pages vs per-request pages.
 CLASSES = ("params", "optimizer-state", "batch", "activation-stash",
-           "gmm-residual", "kv-cache", "collective", "constant",
-           "output", "temp")
+           "gmm-residual", "kv-cache", "kv-shared", "kv-private",
+           "collective", "constant", "output", "temp")
 
 _DTYPE_BYTES = {
     "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
@@ -569,7 +571,7 @@ def xla_memory_stats(compiled) -> dict:
 # ---------------------------------------------------------------------------
 # Step families
 #
-# The 16 registered train/serve families reuse tracekit's runnable
+# The 17 registered train/serve families reuse tracekit's runnable
 # bundles (same factories as train_cli/parallel.serve, donate=False so
 # the bundle is reusable). ARG_CLASSES labels each family's top-level
 # arguments; flattened leaf order matches entry parameter numbering.
@@ -606,7 +608,49 @@ ARG_CLASSES: dict[str, tuple] = {
     # (ISSUE 8: mem_cli must attribute it under kv-cache)
     "serve_engine": ("params", "kv-cache", "batch", "batch", "batch",
                      "batch", "batch", "batch"),
+    "serve_engine_prefix": ("params", "kv-cache", "batch", "batch",
+                            "batch", "batch", "batch", "batch"),
 }
+
+
+# Shared-vs-private kv attribution for the ENGINE families (ISSUE 9).
+# Shared/private is HOST-SIDE allocator state (serving/pool.py refcounts)
+# — invisible in the HLO, where each per-layer pool is ONE buffer — so
+# the split applies the registry geometry's page fractions to the
+# kv-cache class bytes: (shared_pages, total_pages) per shard, the
+# write-scratch page counted PRIVATE (it is written every step).
+# serve_engine_prefix: 1 shared prefix page of 3 real + scratch
+# (registry.serve_engine_prefix_geometry); serve_engine shares nothing
+# but splits anyway so the two families' compositions stay column-
+# comparable. top_buffers keep the raw "kv-cache" label — the physical
+# allocation really is one buffer.
+SERVE_KV_SPLIT: dict[str, tuple[int, int]] = {
+    "serve_engine": (0, 3),
+    "serve_engine_prefix": (1, 4),
+}
+
+
+def split_serve_kv(profile: dict) -> dict:
+    """Rewrite a memprofile's class tables in place, splitting kv-cache
+    into kv-shared/kv-private by the family's page fractions."""
+    frac = SERVE_KV_SPLIT.get(profile.get("family"))
+    if not frac:
+        return profile
+    shared, total = frac
+    tables = [profile.get("composition_bytes", {})]
+    tables += list(profile.get("phase_class_bytes", {}).values())
+    for t in tables:
+        kv = t.pop("kv-cache", None)
+        if kv is None:
+            continue
+        sh = kv * shared // total
+        t["kv-shared"] = sh
+        t["kv-private"] = kv - sh
+    comp = profile.get("composition_bytes")
+    if comp:
+        profile["composition_bytes"] = dict(
+            sorted(comp.items(), key=lambda kv: -kv[1]))
+    return profile
 
 
 def _bench_headline():
@@ -830,7 +874,7 @@ def profile_hlo(hlo_text: str, *, family: str = "custom",
     total = (xla or {}).get("total_bytes")
     if total:
         p["analyzed_over_xla"] = round(analysis.peak_bytes / total, 4)
-    return p
+    return split_serve_kv(p)
 
 
 def profile_family(family: str, top: int = 12) -> dict:

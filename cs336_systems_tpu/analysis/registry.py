@@ -402,6 +402,59 @@ def serve_engine_state(concrete: bool = False):
     return logits, keys, pos, active, row_off, tables
 
 
+def serve_engine_prefix_geometry():
+    """Registry geometry for the ``serve_engine_prefix`` family:
+    ``(slots, pages_per_shard, max_blocks, page_block)``. dp8 mesh, TWO
+    slots per shard, both serving prompts that share one full prefix
+    block: shard-local page 0 is the SHARED prefix page (both block
+    tables reference it — pool refcount 2), pages 1/2 are each slot's
+    private tail. 3 real pages + scratch per shard, vs the analytic
+    UNSHARED twin of 2 private pages per slot = 4 real + scratch — the
+    N·P−P margin (N=2 slots, P=1 prefix page) that
+    scripts/check_prefix_margin.py asserts against memkit's
+    kv-shared/kv-private split."""
+    return 16, 3, 2, SERVE_PAGED_BLOCK
+
+
+def serve_engine_prefix_state(concrete: bool = False):
+    """The serve_engine_prefix step's argument bundle — same layout as
+    ``serve_engine_state`` at the prefix geometry. Concrete state is
+    mid-generation WITH an active shared page: positions at 10 (8-token
+    shared prefix + 2 private tokens), so the write block ``10 // 8 = 1``
+    is PRIVATE for every slot — exactly the copy-on-write invariant
+    models/decode.validate_block_tables enforces; shard-local tables
+    ``[[0, 1], [0, 2]]`` both reference shared page 0."""
+    slots, _, max_blocks, blk = serve_engine_prefix_geometry()
+    cfg = _tiny_cfg()
+    shapes = (
+        ((slots, cfg.vocab_size), jnp.float32),
+        ((slots, 2), jnp.uint32),
+        ((slots,), jnp.int32),
+        ((slots,), jnp.int32),
+        ((slots,), jnp.int32),
+        ((slots, max_blocks), jnp.int32),
+    )
+    if not concrete:
+        return tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes)
+    logits = jnp.zeros(shapes[0][0], jnp.float32)
+    keys = jnp.tile(jax.random.PRNGKey(5)[None, :], (slots, 1))
+    pos = jnp.full((slots,), blk + 2, jnp.int32)
+    active = jnp.ones((slots,), jnp.int32)
+    row_off = jnp.arange(slots, dtype=jnp.int32)
+    tables = jnp.tile(jnp.asarray([[0, 1], [0, 2]], jnp.int32),
+                      (slots // 2, 1))
+    return logits, keys, pos, active, row_off, tables
+
+
+def _engine_pool_abstract(pages_per_shard: int, dp: int = 8):
+    """Abstract per-layer page pools for an engine family: the GLOBAL
+    pool array carries ``dp`` shard-local (pages + scratch) segments."""
+    cfg = _tiny_cfg()
+    return tuple(jax.ShapeDtypeStruct(
+        (dp * (pages_per_shard + 1), cfg.num_heads, SERVE_PAGED_BLOCK,
+         2 * cfg.d_head), cfg.cdtype) for _ in range(cfg.num_layers))
+
+
 def _build_serve_engine() -> Traced:
     from cs336_systems_tpu.parallel.mesh import make_mesh
     from cs336_systems_tpu.parallel.serve import lint_contract
@@ -412,11 +465,33 @@ def _build_serve_engine() -> Traced:
     step = make_engine_step(cfg, blk, mesh=make_mesh({"dp": 8}),
                             dp_axis="dp", temperature=0.9, top_k=8,
                             donate=False)
-    pool = tuple(jax.ShapeDtypeStruct(
-        (slots * (n_pages + 1), cfg.num_heads, blk, 2 * cfg.d_head),
-        cfg.cdtype) for _ in range(cfg.num_layers))
+    pool = _engine_pool_abstract(n_pages)
     jaxpr = jax.make_jaxpr(step)(_abstract_params(cfg), pool,
                                  *serve_engine_state())
+    contract = dict(lint_contract(cfg, dp_axis="dp", decode_only=True),
+                    phase_scopes=SERVE_PHASE_SCOPES)
+    return Traced(jaxpr, None, contract)
+
+
+def _build_serve_engine_prefix() -> Traced:
+    """The engine step at the SHARED-PREFIX geometry. The step program is
+    byte-identical to serve_engine's (prefix reuse is host-side admission
+    state — serving/prefix_cache.py never touches the jaxpr), so the lint
+    contract is the decode-only contract VERBATIM: prefix caching must
+    add ZERO collectives, and any drift here means device code started
+    depending on what pages alias."""
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import lint_contract
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    _, pages, _, blk = serve_engine_prefix_geometry()
+    step = make_engine_step(cfg, blk, mesh=make_mesh({"dp": 8}),
+                            dp_axis="dp", temperature=0.9, top_k=8,
+                            donate=False)
+    pool = _engine_pool_abstract(pages)
+    jaxpr = jax.make_jaxpr(step)(_abstract_params(cfg), pool,
+                                 *serve_engine_prefix_state())
     contract = dict(lint_contract(cfg, dp_axis="dp", decode_only=True),
                     phase_scopes=SERVE_PHASE_SCOPES)
     return Traced(jaxpr, None, contract)
@@ -449,6 +524,7 @@ STEPS: tuple[StepSpec, ...] = (
              functools.partial(_build_serve, {"dp": 8}, "dp",
                                None, None, True, True)),
     StepSpec("serve_engine", _build_serve_engine),
+    StepSpec("serve_engine_prefix", _build_serve_engine_prefix),
 )
 
 
@@ -472,4 +548,8 @@ HBM_BUDGET_BYTES: dict[str, int] = {
     # steady-state step at full occupancy; the slot state is tiny and the
     # pool (kv-cache class) is THE multi-page allocation, so budget creep
     # here means the step started materializing per-slot copies
+    "serve_engine_prefix": 1 << 19,  # same program at the shared-prefix
+    # geometry (2 slots/shard over 3 pages + scratch): a budget trip here
+    # but not on serve_engine means the larger slot batch, not the step,
+    # grew — the kv split (mem_cli) says whether shared or private did
 }

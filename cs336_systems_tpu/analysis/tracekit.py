@@ -586,6 +586,45 @@ def _build_serve_engine() -> Runner:
                   mesh.size)
 
 
+def _build_serve_engine_prefix() -> Runner:
+    """The engine step at the SHARED-PREFIX registry geometry (ISSUE 9):
+    2 slots/shard whose block tables both reference shard-local page 0 —
+    the refcount-2 prefix page — with private write blocks (COW). Same
+    step program as serve_engine; what this family pins is the TIMING of
+    the aliased-read state (two rows attending the same physical page)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from cs336_systems_tpu.analysis.registry import (
+        _tiny_cfg, serve_engine_prefix_geometry, serve_engine_prefix_state)
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import engine_specs
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    slots, pages, _, blk = serve_engine_prefix_geometry()
+    step = make_engine_step(cfg, blk, mesh=mesh, dp_axis="dp",
+                            temperature=0.9, top_k=8, donate=False)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    _, pool_spec, _ = engine_specs(cfg, "dp", None)
+    sh = NamedSharding(mesh, pool_spec)
+    pool = tuple(jax.device_put(
+        jnp.zeros((mesh.size * (pages + 1), cfg.num_heads, blk,
+                   2 * cfg.d_head), cfg.cdtype), sh)
+        for _ in range(cfg.num_layers))
+    state = serve_engine_prefix_state(concrete=True)
+    # every slot attends its 10 consumed tokens (8 shared + 2 private)
+    # + the new one
+    flops = decode_flops_per_token(
+        cfg, attend_lens=np.full((slots,), blk + 3, np.int64))
+    return Runner(step, (params, pool) + tuple(state), slots, flops,
+                  mesh.size)
+
+
 FAMILIES: dict[str, Callable[[], Runner]] = {
     "train_single": _build_train_single,
     "train_single_bf16": _build_train_single_bf16,
@@ -605,6 +644,7 @@ FAMILIES: dict[str, Callable[[], Runner]] = {
     "serve_ragged_paged": lambda: _build_serve({"dp": 8}, "dp", None, None,
                                                True, True),
     "serve_engine": _build_serve_engine,
+    "serve_engine_prefix": _build_serve_engine_prefix,
 }
 
 

@@ -19,6 +19,15 @@ short), ``spike`` = all but one at P/8 plus one straggler at P. Skewed
 profiles are where the page pool earns its keep — short requests join
 and leave while a straggler holds its slot.
 
+``--shared-prefix P`` (ISSUE 9) prepends a common P-token system prompt
+to every request: the engine's prefix cache pays that prefix's prefill
+and KV pages ONCE per trace instead of once per request, and the cell
+reports ``prefix_hit_rate`` (prompt tokens served from shared pages /
+prompt tokens admitted), ``prefill_tokens`` (what actually ran through
+prefill) and ``shared_kv_bytes`` (high-water of shared-page HBM).
+``--no-prefix-cache`` is the unshared A/B baseline — streams are
+bit-identical either way (tests/test_prefix_cache.py pins it).
+
 Every cell flushes via ``emit_row`` the moment it completes (``--out``
 makes the cells durable JSONL), and every trace ends with the page-pool
 conservation check — a leaked page fails the cell, which is the CI
@@ -72,13 +81,26 @@ def profile_lens(profile: str, n: int, prompt_len: int) -> np.ndarray:
 
 
 def build_requests(profile: str, n: int, prompt_len: int, new_tokens: int,
-                   load_rps: float, vocab: int, seed: int) -> list[Request]:
-    """Poisson arrivals: exponential inter-arrival gaps at ``load_rps``."""
+                   load_rps: float, vocab: int, seed: int,
+                   shared_prefix: int = 0) -> list[Request]:
+    """Poisson arrivals: exponential inter-arrival gaps at ``load_rps``.
+
+    ``shared_prefix``: prepend a common P-token system prompt to every
+    request (drawn once from its own rng stream, so the prefix is the
+    same for every cell at a given seed) — the millions-of-users shape
+    the prefix cache (serving/prefix_cache.py) dedups: with the cache on,
+    the prefix's prefill FLOPs and KV pages are paid once per trace, not
+    once per request."""
     rng = np.random.default_rng(seed)
     lens = profile_lens(profile, n, prompt_len)
     arrivals = np.cumsum(rng.exponential(1.0 / load_rps, size=n))
+    prefix = (np.random.default_rng(seed + 1_000_003)
+              .integers(0, vocab, size=shared_prefix)
+              if shared_prefix else np.zeros(0, int))
     return [
-        Request(rid=i, prompt=rng.integers(0, vocab, size=int(lens[i])),
+        Request(rid=i,
+                prompt=np.concatenate(
+                    [prefix, rng.integers(0, vocab, size=int(lens[i]))]),
                 max_new_tokens=new_tokens, arrival=float(arrivals[i]))
         for i in range(n)
     ]
@@ -124,13 +146,22 @@ def run_cell(engine: ServingEngine, requests: list[Request],
         "p99_ms": round(float(np.percentile(samples, 99)) * 1e3, 3),
         "ttft_mean_ms": round(float(np.mean(ttfts)) * 1e3, 3)
         if ttfts else 0.0,
+        # prefix-cache columns (ISSUE 9): fraction of admitted prompt
+        # tokens served from shared pages instead of prefill, tokens
+        # actually prefilled, and the high-water of shared-page HBM
+        "prefix_hit_rate": round(
+            engine.prefix_hit_tokens / max(engine.prefix_prompt_tokens, 1),
+            4),
+        "prefill_tokens": engine.prefill_tokens,
+        "shared_kv_bytes": engine.shared_kv_bytes_peak,
     }
 
 
 def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
           prompt_len: int, new_tokens: int, slots: int, n_pages: int,
           max_blocks: int, page_block: int, dp: int, seed: int,
-          slo_ms: float, out_path: str | None) -> list[dict]:
+          slo_ms: float, out_path: str | None, shared_prefix: int = 0,
+          prefix_cache: bool = True) -> list[dict]:
     params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
     mesh = dp_axis = None
     if dp:
@@ -147,14 +178,16 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
                 params, cfg, key=jax.random.PRNGKey(0), slots=slots,
                 n_pages=n_pages, max_blocks=max_blocks,
                 page_block=page_block, temperature=0.9, top_k=8,
-                mesh=mesh, dp_axis=dp_axis,
+                mesh=mesh, dp_axis=dp_axis, prefix_cache=prefix_cache,
                 clock=lambda: time.monotonic() - t0)
             reqs = build_requests(profile, n_requests, prompt_len,
-                                  new_tokens, load, cfg.vocab_size, seed)
+                                  new_tokens, load, cfg.vocab_size, seed,
+                                  shared_prefix)
             row = {"name": f"engine_poisson_{profile}_load{load:g}",
                    "load_rps": load, "profile": profile,
                    "requests": n_requests, "slots": slots,
-                   "n_pages": n_pages, "slo_ms": slo_ms}
+                   "n_pages": n_pages, "slo_ms": slo_ms,
+                   "shared_prefix": shared_prefix}
             row.update(run_cell(engine, reqs, slo_ms))
             emit_row(row, out_path)
             rows.append(row)
@@ -187,6 +220,14 @@ def main() -> None:
                         "model, models/decode.PAGE_BLOCK otherwise)")
     p.add_argument("--slo-ms", type=float, default=500.0,
                    help="per-token latency SLO for the goodput column")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="prepend a common P-token system prompt to every "
+                        "request — the prefix cache dedups its prefill "
+                        "and KV pages (prefix_hit_rate/shared_kv_bytes "
+                        "columns)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the engine's prefix cache (the unshared "
+                        "A/B baseline)")
     p.add_argument("--dp", type=int, default=0,
                    help="shard slots over a dp mesh of this size (0 = "
                         "single device)")
@@ -201,18 +242,20 @@ def main() -> None:
                                 d_model=64, d_ff=128, num_layers=2,
                                 num_heads=4)
         args.prompt = min(args.prompt, 16)
-        args.new = min(args.new, cfg.context_length - args.prompt)
+        args.new = min(args.new, cfg.context_length - args.prompt
+                       - args.shared_prefix)
     else:
         cfg = config_for_size(args.size)
-        if args.prompt + args.new > cfg.context_length:
-            raise SystemExit(
-                f"prompt+new = {args.prompt + args.new} exceeds "
-                f"context_length={cfg.context_length}")
+    longest = args.shared_prefix + args.prompt + args.new
+    if longest > cfg.context_length:
+        raise SystemExit(
+            f"shared_prefix+prompt+new = {longest} exceeds "
+            f"context_length={cfg.context_length}")
     if args.page_block <= 0:
         from cs336_systems_tpu.models.decode import PAGE_BLOCK
 
         args.page_block = 8 if args.test_model else PAGE_BLOCK
-    per_req = -(-(args.prompt + args.new) // args.page_block)
+    per_req = -(-longest // args.page_block)
     max_blocks = per_req
     dp = max(args.dp, 1)
     if args.slots % dp:
@@ -223,7 +266,8 @@ def main() -> None:
     rows = sweep(cfg, args.loads, args.profiles, args.requests,
                  args.prompt, args.new, args.slots, n_pages, max_blocks,
                  args.page_block, args.dp, args.seed, args.slo_ms,
-                 args.out)
+                 args.out, shared_prefix=args.shared_prefix,
+                 prefix_cache=not args.no_prefix_cache)
     print_table(results_table(rows, latex_path=args.latex))
 
 

@@ -126,7 +126,9 @@ def paged_kv_geometry(prompt_lens, max_new_tokens: int,
         page_rows.astype(np.int32), page_blks.astype(np.int32))
 
 
-def validate_block_tables(tables, n_pages: int) -> None:
+def validate_block_tables(tables, n_pages: int, read_only=None,
+                          write_pos=None, block: int | None = None,
+                          active=None) -> None:
     """Host-side hard check of the reserved-scratch-page contract: every
     block-table entry must be a REAL page id in [0, n_pages) — page id
     ``n_pages`` (array index n_pages of the [n_pages + 1]-page pool) is
@@ -135,7 +137,19 @@ def validate_block_tables(tables, n_pages: int) -> None:
     by every table producer (paged_kv_geometry consumers, the serving
     page-pool allocator) before tables reach a device op; the in-kernel
     clamp in ops/decode_attention is defensive only and silently corrupts
-    reads, which is exactly why the violation must be caught here."""
+    reads, which is exactly why the violation must be caught here.
+
+    ``read_only``: optional set of SHARED page ids (the prefix cache's
+    immutable pages, PagePool.shared_page_ids) — the copy-on-write
+    contract. With ``write_pos`` ([B] per-row positions) and ``block``
+    also given, each row's WRITE TARGET ``tables[i, pos_i // block]``
+    must not be a shared page: the paged kernel writes exactly that
+    block, so a shared id there would let one request's decode stamp
+    every other reference-holder's prefix. ``active``: optional [B]
+    mask — inactive rows write the scratch page, not their table, so
+    they are exempt. Rows whose write position is past the table width
+    (a finished row at its last block boundary) are skipped: the engine
+    evicts them before the next step dispatch."""
     import numpy as np
 
     t = np.asarray(tables)
@@ -154,6 +168,27 @@ def validate_block_tables(tables, n_pages: int) -> None:
         raise ValueError(
             f"block table entry {tuple(int(i) for i in where)} = "
             f"{int(t.max())} out of range for a {n_pages}-page pool")
+    if read_only is None or write_pos is None or block is None:
+        return
+    ro = set(int(p) for p in read_only)
+    if not ro:
+        return
+    pos = np.asarray(write_pos, np.int64)
+    act = (np.ones(t.shape[0], bool) if active is None
+           else np.asarray(active).astype(bool))
+    for i in range(t.shape[0]):
+        if not act[i]:
+            continue
+        wb = int(pos[i]) // block
+        if wb >= t.shape[1]:
+            continue  # finished row at its final boundary; evicted next
+        page = int(t[i, wb])
+        if page in ro:
+            raise ValueError(
+                f"row {i} would WRITE shared (read-only) page {page} at "
+                f"block {wb} (pos {int(pos[i])}) — copy-on-write requires "
+                "the first partially-filled block to be private "
+                "(serving/prefix_cache.py module docstring)")
 
 
 def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int, block: int,
@@ -688,6 +723,143 @@ def slot_prefill(params, prompt_ids, cfg: TransformerConfig, prompt_lens,
         prompt_lens=prompt_lens, page_block=page_block, page_geom=page_geom)
     pages = tuple(kv[:-1] for kv in cache["kv"])  # drop the local scratch
     return logits, pages, nxt
+
+
+def prefill_suffix(params, suffix_ids, cfg: TransformerConfig, suffix_lens,
+                   prefix_lens, prefix_tables, kv_pool, page_block: int,
+                   page_geom, reduce_axis: str | None = None):
+    """Prefill ONLY the uncached suffix of each row, attending the cached
+    prefix KV straight out of the paged pool (the prefix-cache reuse path
+    — serving/prefix_cache.py).
+
+    The cached pages hold exactly the post-RoPE K‖V the full prefill
+    would have produced for those positions (``prefill`` captures each
+    layer's post-rope k/v), so running the suffix tokens at their
+    ABSOLUTE positions against the gathered prefix keys reproduces the
+    full-prompt forward bit-for-bit: rope tables, causal structure and
+    softmax operand sets are identical, and masked pad keys contribute
+    exact zeros.
+
+    ``suffix_ids``: [B, SW] LEFT-ALIGNED suffix tokens, row i's real
+    tokens in [0, suffix_lens_i); ``prefix_lens``: [B] int32 cached-
+    prefix lengths, each a MULTIPLE of ``page_block`` (the cache only
+    publishes full blocks) — row i's suffix token j sits at absolute
+    position prefix_lens_i + j. ``prefix_tables``: [B, PNB] page ids
+    into ``kv_pool`` covering each row's prefix blocks in order, padded
+    past prefix_lens_i // block with ANY valid pool index (the mask
+    retires them; the engine pads with the scratch page). ``kv_pool``:
+    per-layer tuple of [n_pages + 1, H, block, 2*Dh] pool arrays — READ
+    only, shared pages are never written here. ``page_geom``:
+    (ignored, page_rows, page_blks) local throwaway geometry over the
+    SUFFIX blocks only, exactly ``slot_prefill``'s convention.
+
+    Returns (last-real-suffix-token logits [B, vocab] fp32, per-layer
+    suffix page arrays laid out by ``page_geom`` — local scratch already
+    dropped — next positions prefix_lens + suffix_lens [B] int32). The
+    layer loop is UNROLLED (not scanned) so each layer reads its own
+    pool leaf without stacking the pool into an [L, ...] copy.
+
+    The ``optimization_barrier`` calls are LOAD-BEARING for the
+    bit-exactness contract: the gather+concat attention operands invite
+    fusions the full prefill never sees, and on CPU a fusion boundary
+    can flip an op to FMA codegen — observed as 1-ulp drift on k after
+    rope at some batch shapes, which sampling then amplifies into a
+    divergent stream. Pinning materialization at the q/k/v, attention
+    and residual boundaries makes every segment compute from
+    materialized inputs, which measurably reproduces the full prefill's
+    values bit-for-bit (tests/test_prefix_cache.py pins this engine-
+    level; padding rows past suffix_lens still hold junk — never
+    attended, overwritten by decode one row per step)."""
+    b, sw = suffix_ids.shape
+    dh, blk = cfg.d_head, page_block
+    blocks = params["blocks"]
+    h = _local_heads(blocks["attn"], cfg)
+    if isinstance(blocks, (tuple, list)):
+        per_layer = blocks
+    else:
+        per_layer = tuple(
+            jax.tree_util.tree_map(lambda a, l=l: a[l], blocks)
+            for l in range(cfg.num_layers))
+    cos, sin = rope_cache(cfg.context_length, cfg.d_head, cfg.rope_theta)
+
+    from cs336_systems_tpu.ops.attention import attention_with_lse
+    from cs336_systems_tpu.ops.decode_attention import pack_kv
+
+    slens = jnp.asarray(suffix_lens, jnp.int32)
+    plens = jnp.asarray(prefix_lens, jnp.int32)
+    tables = jnp.asarray(prefix_tables, jnp.int32)
+    pnb = tables.shape[1]
+    pn = pnb * blk  # gathered prefix key width
+
+    # absolute positions: queries at prefix_lens + [0, SW); prefix keys
+    # at [0, pn) (block-aligned, so gathered block j covers exactly
+    # [j*blk, (j+1)*blk)); mask validity per row by the real lengths
+    qpos = plens[:, None] + jnp.arange(sw)[None, :]          # [B, SW]
+    kpos = jnp.concatenate(
+        [jnp.broadcast_to(jnp.arange(pn)[None, :], (b, pn)), qpos], axis=1)
+    kvalid = jnp.concatenate(
+        [jnp.arange(pn)[None, :] < plens[:, None],
+         jnp.arange(sw)[None, :] < slens[:, None]], axis=1)   # [B, pn+SW]
+    mask = (kpos[:, None, :] <= qpos[:, :, None]) & kvalid[:, None, :]
+    if cfg.attn_window is not None:
+        mask &= (qpos[:, :, None] - kpos[:, None, :]) < cfg.attn_window
+    mask = mask[:, None]  # [B, 1, SW, pn+SW] — broadcasts over heads
+
+    x = embedding(params["token_embeddings"], suffix_ids, cfg.cdtype)
+    ks, vs = [], []
+    for bp, pool_l in zip(per_layer, kv_pool):
+        with annotate("attn"):
+            hsplit = lambda t: t.reshape(b, sw, h, dh).transpose(0, 2, 1, 3)
+            hx = rmsnorm(bp["ln1"], x)
+            q = hsplit(linear(bp["attn"]["q_proj"], hx, cfg.cdtype))
+            k = hsplit(linear(bp["attn"]["k_proj"], hx, cfg.cdtype))
+            v = hsplit(linear(bp["attn"]["v_proj"], hx, cfg.cdtype))
+            q = apply_rope(q, cos, sin, qpos[:, None, :])
+            k = apply_rope(k, cos, sin, qpos[:, None, :])
+            q, k, v = jax.lax.optimization_barrier((q, k, v))
+            # cached prefix K/V: gather the rows' pages and unpack —
+            # [B, PNB, H, blk, W] -> [B, H, pn, W]; post-rope already
+            pkv = pool_l[tables].transpose(0, 2, 1, 3, 4).reshape(
+                b, h, pn, 2 * dh)
+            k_all = jnp.concatenate([pkv[..., :dh], k], axis=2)
+            v_all = jnp.concatenate([pkv[..., dh:], v], axis=2)
+            attn = attention_with_lse(q, k_all, v_all, mask)[0]
+            attn = jax.lax.optimization_barrier(attn)
+            attn = attn.transpose(0, 2, 1, 3).reshape(b, sw, h * dh)
+            attn_out = linear(bp["attn"]["output_proj"], attn, cfg.cdtype)
+            if reduce_axis is not None:
+                attn_out = jax.lax.psum(attn_out, reduce_axis)
+        x = jax.lax.optimization_barrier(x + attn_out)
+        with annotate("ffn"):
+            ffn_out = _ffn(bp["ffn"], rmsnorm(bp["ln2"], x), cfg)
+        if reduce_axis is not None and cfg.num_experts == 0:
+            ffn_out = jax.lax.psum(ffn_out, reduce_axis)
+        x = jax.lax.optimization_barrier(x + ffn_out)
+        ks.append(k)
+        vs.append(v)
+
+    x = rmsnorm(params["ln_final"], x)
+    x_last = jnp.take_along_axis(x, (slens - 1)[:, None, None], axis=1)
+    logits = linear(params["lm_head"], x_last, cfg.cdtype)[:, 0]
+    logits = logits.astype(jnp.float32)
+
+    # lay the SUFFIX K/V out into page_geom's pages — the suffix starts
+    # block-aligned, so the per-row packing is prefill's paged branch
+    # verbatim over [B, SW]
+    _tables, page_rows, page_blks = page_geom
+    nbp = -(-sw // blk)
+    pad = nbp * blk - sw
+    src = page_rows * nbp + jnp.minimum(page_blks, nbp - 1)
+    with annotate("kv_update"):
+        pages = []
+        for l in range(cfg.num_layers):
+            packed = pack_kv(ks[l], vs[l])  # [B, H, SW, W]
+            if pad:
+                packed = jnp.pad(packed, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            src_pages = packed.reshape(
+                b, h, nbp, blk, 2 * dh).transpose(0, 2, 1, 3, 4)
+            pages.append(src_pages.reshape(b * nbp, h, blk, 2 * dh)[src])
+    return logits, tuple(pages), plens + slens
 
 
 def unstack_blocks(params):

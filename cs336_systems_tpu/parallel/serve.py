@@ -46,6 +46,14 @@ from its own position — per-row rope angles, per-row attend masks, and a
 per-row write column in the packed-KV kernel (models/decode.prefill /
 ops/decode_attention). The lengths shard with the batch over dp and
 replicate over tp; tokens equal each row's own single-row generation.
+
+Prefix caching (ISSUE 9) is SHARD-LOCAL by construction: paged pools
+shard their page axis over dp (engine_specs), page ids are shard-local,
+and the engine keeps one serving/prefix_cache.PrefixCache per dp shard
+over that shard's PagePool. Sharing happens entirely in host-side
+admission state — no page, hash chain or refcount ever crosses the
+mesh, so the engine step program (and its collective count, pinned by
+the lint contract) is byte-identical with the cache on or off.
 """
 
 from __future__ import annotations

@@ -14,16 +14,27 @@ step count. The continuous-batching shape follows the vLLM/PagedAttention
 lineage the paged pool was built for (see PAPERS.md: Ragged Paged
 Attention; goodput-under-SLO as the headline metric follows the
 Gemma-on-TPU serving comparison point).
+
+ISSUE 9 adds the prefix cache (prefix_cache.py): completed-prefill KV
+pages published into a hash-chain trie and shared COPY-ON-WRITE across
+requests via PagePool refcounts — N requests with a common system prompt
+pay its prefill and HBM once, host-side only, zero new collectives.
 """
 
 from cs336_systems_tpu.serving.engine import ServingEngine, make_engine_step
 from cs336_systems_tpu.serving.pool import PagePool
+from cs336_systems_tpu.serving.prefix_cache import (
+    PrefixCache,
+    params_fingerprint,
+)
 from cs336_systems_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = [
     "PagePool",
+    "PrefixCache",
     "Request",
     "Scheduler",
     "ServingEngine",
     "make_engine_step",
+    "params_fingerprint",
 ]

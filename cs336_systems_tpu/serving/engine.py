@@ -35,6 +35,18 @@ was built for):
   tables are shard-local; no page crosses the mesh); tp shards heads.
   The decode-only collective contract is serve.lint_contract(...,
   decode_only=True): dp = 0 psums, tp = 2L.
+- PREFIX CACHE (ISSUE 9, default on): each dp shard holds a
+  serving/prefix_cache.PrefixCache over its PagePool. Admission looks up
+  the longest cached page-aligned prefix, ACQUIRES those immutable pages
+  (refcount bump — N tables, one physical page), allocates private pages
+  only for the divergent tail, and prefills ONLY the uncached suffix
+  (models/decode.prefill_suffix — bit-equal to the full prefill, see its
+  docstring); completed prefills PUBLISH their full prompt blocks back.
+  Copy-on-write is enforced every dispatch: validate_block_tables checks
+  no active row's write block is a shared page (the write block is
+  always >= plen // block, i.e. private by construction). All of this is
+  host-side allocator work — the step program and its collective
+  contract are byte-identical with the cache on or off.
 
 TPU perf notes (CPU-correct here; open items for the chip, queued in
 results/decode_v5e.txt): per-slot host state is re-uploaded every step
@@ -60,6 +72,7 @@ from cs336_systems_tpu.models.decode import (
     PAGE_BLOCK,
     _sample,
     decode_step,
+    prefill_suffix,
     slot_prefill,
     unstack_blocks,
     validate_block_tables,
@@ -68,6 +81,7 @@ from cs336_systems_tpu.models.transformer import TransformerConfig
 from cs336_systems_tpu.parallel.serve import engine_specs
 from cs336_systems_tpu.parallel.serve import lint_contract as _serve_lint
 from cs336_systems_tpu.serving.pool import PagePool
+from cs336_systems_tpu.serving.prefix_cache import PrefixCache, params_fingerprint
 from cs336_systems_tpu.serving.scheduler import Request, Scheduler
 
 
@@ -151,7 +165,9 @@ class ServingEngine:
     (the oracle's truncation excludes the EOS token) and its pages free
     immediately. ``clock``: callable for arrival/latency timestamps
     (benchmarks pass time.monotonic; tests drive virtual time through
-    ``step(now)``/``run(time_fn)``)."""
+    ``step(now)``/``run(time_fn)``). ``prefix_cache``: shard-local
+    shared-prefix KV page reuse (default on; False builds the unshared
+    twin — same streams bit-for-bit, no page sharing)."""
 
     def __init__(self, params, cfg: TransformerConfig, *, key,
                  slots: int, n_pages: int, max_blocks: int,
@@ -162,7 +178,7 @@ class ServingEngine:
                  attn_impl: str = "auto", approx_top_k: bool = False,
                  mesh=None, dp_axis: str | None = None,
                  tp_axis: str | None = None,
-                 clock=None, on_token=None):
+                 clock=None, on_token=None, prefix_cache: bool = True):
         if page_block <= 0 or page_block % 8:
             raise ValueError(
                 f"page block must be a positive multiple of 8, "
@@ -193,6 +209,24 @@ class ServingEngine:
 
         # shard-local allocators — page ids in the tables are shard-local
         self.pools = [PagePool(n_pages) for _ in range(dp)]
+        # shard-local prefix caches (prefix_cache=False: the unshared
+        # twin for A/B tests and the memkit margin check)
+        self.prefix_caches = None
+        if prefix_cache:
+            fp = params_fingerprint(params)
+            self.prefix_caches = [
+                PrefixCache(self.pools[k], page_block, fp)
+                for k in range(dp)]
+        # one physical page's HBM across all layers (full heads — the
+        # host books model bytes, not per-tp-shard bytes)
+        self._page_bytes = (cfg.num_heads * page_block * 2 * cfg.d_head
+                            * jnp.dtype(cfg.cdtype).itemsize
+                            * cfg.num_layers)
+        # prefix telemetry (benchmarks/serving.py columns)
+        self.prefix_hit_tokens = 0     # prompt tokens served from cache
+        self.prefix_prompt_tokens = 0  # prompt tokens admitted
+        self.prefill_tokens = 0        # tokens actually run through prefill
+        self.shared_kv_bytes_peak = 0  # high-water of shared-page HBM
         self.scheduler = Scheduler()
         self.running: dict[int, Request] = {}
         self.results: dict[int, np.ndarray] = {}
@@ -247,31 +281,122 @@ class ServingEngine:
         self.scheduler.submit(req)
 
     def _admit(self, now: float) -> int:
-        """Strict-FIFO join: the head request takes the lowest free slot
-        whose shard allocator can hold its pages; if none can, it BLOCKS
-        (nothing behind it bypasses) until an eviction frees capacity."""
+        """Strict-FIFO join: the head request takes a free slot whose
+        shard allocator can hold its pages; if none can, it BLOCKS
+        (nothing behind it bypasses) until an eviction frees capacity.
+
+        With the prefix cache on, per head request: look up the longest
+        cached page-aligned prefix on each shard with a free slot, pick
+        the deepest hit (ties -> lowest slot, the FIFO order), ACQUIRE
+        the hit pages, LRU-spill unreferenced cached pages if the free
+        list is short (acquire-first so the spill can never reclaim the
+        request's own hit), and allocate private pages only for the
+        tail. Feasibility counts spillable pages, so cached-but-idle
+        prefixes can never deadlock admission. A full-prompt hit whose
+        final trie node cached boundary logits joins with ZERO device
+        work. When the head request's missing blocks are about to be
+        published by joins already collected in THIS batch, the batch is
+        FLUSHED first (prefill + publish) and admission continues — an
+        arrival burst sharing a cold prefix prefills it once, not N
+        times."""
+        admitted = 0
         joins = []
+        # chain hashes the current join batch will publish, per shard
+        pending = [set() for _ in range(self.dp)]
         while True:
             req = self.scheduler.head(now)
             if req is None:
                 break
             npg = self._pages_needed(req)
-            slot = None
+            # lowest free slot per shard
+            free_slot = {}
             for s in range(self.slots):
-                if s in self.running:
-                    continue
-                if self.pools[s // self.slots_per].available >= npg:
-                    slot = s
+                k = s // self.slots_per
+                if s not in self.running and k not in free_slot:
+                    free_slot[k] = s
+            if self.prefix_caches is None:
+                slot = None
+                for k in sorted(free_slot):
+                    if self.pools[k].available >= npg:
+                        slot = free_slot[k]
+                        break
+                if slot is None:
                     break
-            if slot is None:
+                self.scheduler.pop()
+                pages = self.pools[slot // self.slots_per].alloc(
+                    npg, req.rid)
+                self.running[slot] = req
+                self.prefill_tokens += req.prompt.size
+                joins.append((slot, req, pages, 0, []))
+                admitted += 1
+                continue
+
+            hashes = (self.prefix_caches[0].chain_hashes(req.prompt)
+                      if free_slot else [])
+            # flush-on-pending-conflict: the blocks this request misses
+            # are being published by the batch we're holding — land them
+            # first so this request (and the rest of the burst) can hit
+            if joins and any(h in pending[k] for k in free_slot
+                             for h in hashes):
+                self._prefill_joins(joins)
+                joins = []
+                pending = [set() for _ in range(self.dp)]
+                continue
+            best = None  # (-hit, slot, shard, pages, logits)
+            for k in sorted(free_slot):
+                pool, cache = self.pools[k], self.prefix_caches[k]
+                hit, pages, logits = cache.lookup(req.prompt)
+                # the hit's own refcount-0 pages stop being spillable
+                # the moment we acquire them — discount them
+                hit_ref0 = sum(1 for p in pages if pool.refcount(p) == 0)
+                if (pool.available + cache.spillable_pages() - hit_ref0
+                        < npg - hit):
+                    continue
+                cand = (-hit, free_slot[k], k, pages, logits)
+                if best is None or cand < best:
+                    best = cand
+            if best is None:
                 break
+            neg_hit, slot, shard, hit_pages, cached_logits = best
+            hit = -neg_hit
             self.scheduler.pop()
-            pages = self.pools[slot // self.slots_per].alloc(npg, req.rid)
+            pool, cache = self.pools[shard], self.prefix_caches[shard]
+            if hit:
+                pool.acquire(hit_pages, req.rid)
+            need = npg - hit  # >= 1: growth pages outlive the prompt
+            if need > pool.available:
+                cache.spill(need - pool.available)
+            priv = pool.alloc(need, req.rid)
             self.running[slot] = req
-            joins.append((slot, req, pages))
+            req.prefix_hit_tokens = hit * self.page_block
+            self.prefix_hit_tokens += hit * self.page_block
+            self.prefix_prompt_tokens += req.prompt.size
+            admitted += 1
+            if cached_logits is not None:
+                # zero-prefill join: the whole prompt is cached and the
+                # publisher's boundary logits replay the join state
+                self.logits[slot] = cached_logits
+                self.pos[slot] = req.prompt.size
+                self.active[slot] = 1
+                self.keys[slot] = self.base_key
+                self.row_off[slot] = req.row
+                tab = hit_pages + priv
+                self.tables[slot] = tab + [tab[-1]] * (
+                    self.max_blocks - len(tab))
+                self._update_shared_peak()
+                continue
+            self.prefill_tokens += req.prompt.size - hit * self.page_block
+            pending[shard].update(hashes[hit:])
+            joins.append((slot, req, priv, hit, hit_pages))
         if joins:
             self._prefill_joins(joins)
-        return len(joins)
+        return admitted
+
+    def _update_shared_peak(self) -> None:
+        if self.prefix_caches is None:
+            return
+        cur = sum(len(c) for c in self.prefix_caches) * self._page_bytes
+        self.shared_kv_bytes_peak = max(self.shared_kv_bytes_peak, cur)
 
     # -- prefill-into-pool -------------------------------------------
 
@@ -303,6 +428,39 @@ class ServingEngine:
         self._pf_cache[cache_key] = fn
         return fn
 
+    def _prefill_suffix_fn(self, jw: int, sw: int, npg: int, pnb: int):
+        """Compiled suffix-prefill bucket: like ``_prefill_fn`` but the
+        rows attend their cached prefix pages out of the (donated) pool
+        via models/decode.prefill_suffix and scatter only SUFFIX pages."""
+        cache_key = ("sfx", jw, sw, npg, pnb)
+        fn = self._pf_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        cfg, blk, tp = self.cfg, self.page_block, self.tp_axis
+
+        def local(params, pool, ids, slens, plens, ptab, prows, pblks,
+                  dest):
+            logits, pages, _ = prefill_suffix(
+                params, ids, cfg, slens, plens, ptab, pool, blk,
+                (None, prows, pblks), reduce_axis=tp)
+            pool = tuple(x.at[dest].set(pg) for x, pg in zip(pool, pages))
+            return logits, pool
+
+        if self.mesh is None:
+            fn = jax.jit(local, donate_argnums=(1,))
+        else:
+            pspecs, pool_spec, batch_spec = engine_specs(
+                cfg, self.dp_axis, tp)
+            fn = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(pspecs, pool_spec, batch_spec, batch_spec,
+                          batch_spec, batch_spec, batch_spec, batch_spec,
+                          batch_spec),
+                out_specs=(batch_spec, pool_spec),
+                check_vma=False), donate_argnums=(1,))
+        self._pf_cache[cache_key] = fn
+        return fn
+
     def _prefill_joins(self, joins) -> None:
         """Prefill the join batch and scatter its pages into the pool.
 
@@ -313,57 +471,132 @@ class ServingEngine:
         shard's LOCAL scratch page (id n_pages — never in a table), so
         junk K/V never lands on allocated pages. Row-local numerics make
         each request's prefill bit-equal to the oracle's regardless of
-        the join batch around it."""
+        the join batch around it.
+
+        Joins are (slot, req, private_pages, hit_blocks, hit_pages).
+        All-miss batches take the full-prompt path; any prefix hit
+        switches the batch to the SUFFIX path (prefill_suffix) where
+        each row runs only its uncached tail against its acquired
+        prefix pages. Either way, completed rows PUBLISH their full
+        prompt blocks into the shard's prefix cache."""
         blk, dp, npages = self.page_block, self.dp, self.n_pages
         per_shard = [[] for _ in range(dp)]
-        for slot, req, pages in joins:
-            per_shard[slot // self.slots_per].append((slot, req, pages))
+        for j in joins:
+            per_shard[j[0] // self.slots_per].append(j)
         jw = _pow2(max(len(v) for v in per_shard))
-        plen = -(-max(req.prompt.size for _, req, _ in joins) // 8) * 8
-        npg = _pow2(max(
-            max((sum(-(-req.prompt.size // blk) for _, req, _ in v)
-                 for v in per_shard if v), default=1), 1))
+        max_hit = max(j[3] for j in joins)
 
-        ids = np.zeros((dp * jw, plen), np.int32)
-        lens = np.ones((dp * jw,), np.int32)  # dummy rows: 1 pad token
-        prows = np.zeros((dp * npg,), np.int32)
-        pblks = np.zeros((dp * npg,), np.int32)
-        dest = np.full((dp * npg,), npages, np.int32)  # default: scratch
-        for k, v in enumerate(per_shard):
-            o = 0
-            for r, (slot, req, pages) in enumerate(v):
-                ln = req.prompt.size
-                ids[k * jw + r, :ln] = req.prompt
-                lens[k * jw + r] = ln
-                nbp = -(-ln // blk)  # prompt blocks only; growth pages
-                # start with stale/zero data decode overwrites pre-attend
-                prows[k * npg + o:k * npg + o + nbp] = r
-                pblks[k * npg + o:k * npg + o + nbp] = np.arange(nbp)
-                dest[k * npg + o:k * npg + o + nbp] = pages[:nbp]
-                o += nbp
+        if max_hit == 0:
+            plen = -(-max(j[1].prompt.size for j in joins) // 8) * 8
+            npg = _pow2(max(
+                max((sum(-(-req.prompt.size // blk) for _, req, *_ in v)
+                     for v in per_shard if v), default=1), 1))
+            ids = np.zeros((dp * jw, plen), np.int32)
+            lens = np.ones((dp * jw,), np.int32)  # dummy rows: 1 pad token
+            prows = np.zeros((dp * npg,), np.int32)
+            pblks = np.zeros((dp * npg,), np.int32)
+            dest = np.full((dp * npg,), npages, np.int32)  # default: scratch
+            for k, v in enumerate(per_shard):
+                o = 0
+                for r, (slot, req, pages, _hit, _hp) in enumerate(v):
+                    ln = req.prompt.size
+                    ids[k * jw + r, :ln] = req.prompt
+                    lens[k * jw + r] = ln
+                    nbp = -(-ln // blk)  # prompt blocks only; growth pages
+                    # start with stale/zero data decode overwrites
+                    prows[k * npg + o:k * npg + o + nbp] = r
+                    pblks[k * npg + o:k * npg + o + nbp] = np.arange(nbp)
+                    dest[k * npg + o:k * npg + o + nbp] = pages[:nbp]
+                    o += nbp
+            fn = self._prefill_fn(jw, plen, npg)
+            logits, self._pool = fn(
+                self.params, self._pool, jnp.asarray(ids),
+                jnp.asarray(lens), jnp.asarray(prows),
+                jnp.asarray(pblks), jnp.asarray(dest))
+        else:
+            sfx = lambda req, hit: req.prompt.size - hit * blk
+            sw = -(-max(sfx(req, hit)
+                        for _, req, _, hit, _hp in joins) // 8) * 8
+            npg = _pow2(max(
+                max((sum(-(-sfx(req, hit) // blk)
+                         for _, req, _, hit, _hp in v)
+                     for v in per_shard if v), default=1), 1))
+            pnb = _pow2(max(max_hit, 1))
+            ids = np.zeros((dp * jw, sw), np.int32)
+            slens = np.ones((dp * jw,), np.int32)
+            plens = np.zeros((dp * jw,), np.int32)
+            # pad table entries read the scratch page; the validity mask
+            # retires them before they reach a softmax
+            ptab = np.full((dp * jw, pnb), npages, np.int32)
+            prows = np.zeros((dp * npg,), np.int32)
+            pblks = np.zeros((dp * npg,), np.int32)
+            dest = np.full((dp * npg,), npages, np.int32)
+            for k, v in enumerate(per_shard):
+                o = 0
+                for r, (slot, req, priv, hit, hit_pages) in enumerate(v):
+                    ln = sfx(req, hit)
+                    ids[k * jw + r, :ln] = req.prompt[hit * blk:]
+                    slens[k * jw + r] = ln
+                    plens[k * jw + r] = hit * blk
+                    ptab[k * jw + r, :hit] = hit_pages
+                    nbp = -(-ln // blk)  # suffix prompt blocks
+                    prows[k * npg + o:k * npg + o + nbp] = r
+                    pblks[k * npg + o:k * npg + o + nbp] = np.arange(nbp)
+                    dest[k * npg + o:k * npg + o + nbp] = priv[:nbp]
+                    o += nbp
+            fn = self._prefill_suffix_fn(jw, sw, npg, pnb)
+            logits, self._pool = fn(
+                self.params, self._pool, jnp.asarray(ids),
+                jnp.asarray(slens), jnp.asarray(plens),
+                jnp.asarray(ptab), jnp.asarray(prows),
+                jnp.asarray(pblks), jnp.asarray(dest))
 
-        fn = self._prefill_fn(jw, plen, npg)
-        logits, self._pool = fn(self.params, self._pool, jnp.asarray(ids),
-                                jnp.asarray(lens), jnp.asarray(prows),
-                                jnp.asarray(pblks), jnp.asarray(dest))
         lg = np.asarray(jax.device_get(logits))
         for k, v in enumerate(per_shard):
-            for r, (slot, req, pages) in enumerate(v):
+            for r, (slot, req, priv, hit, hit_pages) in enumerate(v):
                 self.logits[slot] = lg[k * jw + r]
                 self.pos[slot] = req.prompt.size
                 self.active[slot] = 1
                 self.keys[slot] = self.base_key  # fresh per-slot chain
                 self.row_off[slot] = req.row
-                self.tables[slot] = (pages
-                                     + [pages[-1]]
-                                     * (self.max_blocks - len(pages)))
-        # the scratch-never-in-a-table contract, checked on every join
-        validate_block_tables(self.tables, self.n_pages)
+                tab = list(hit_pages) + list(priv)
+                self.tables[slot] = tab + [tab[-1]] * (
+                    self.max_blocks - len(tab))
+        if self.prefix_caches is not None:
+            for slot, req, priv, hit, hit_pages in joins:
+                cache = self.prefix_caches[slot // self.slots_per]
+                nbp = -(-(req.prompt.size - hit * blk) // blk)
+                cache.publish(
+                    req.prompt, req.rid,
+                    {hit + j: priv[j] for j in range(nbp)},
+                    logits=self.logits[slot])
+            self._update_shared_peak()
+        # scratch-never-in-a-table + copy-on-write, checked on every join
+        self._validate_tables()
+
+    def _validate_tables(self) -> None:
+        """The block-table contracts, per shard: no scratch id in any
+        table, and (prefix cache on) no ACTIVE row's write block on a
+        shared page — models/decode.validate_block_tables."""
+        if self.prefix_caches is None:
+            validate_block_tables(self.tables, self.n_pages)
+            return
+        for k in range(self.dp):
+            sl = slice(k * self.slots_per, (k + 1) * self.slots_per)
+            validate_block_tables(
+                self.tables[sl], self.n_pages,
+                read_only=self.pools[k].shared_page_ids(),
+                write_pos=self.pos[sl], block=self.page_block,
+                active=self.active[sl])
 
     # -- the steady-state step ---------------------------------------
 
     def _finish(self, slot: int, req: Request, when: float) -> None:
-        self.pools[slot // self.slots_per].free(req.rid)
+        pool = self.pools[slot // self.slots_per]
+        if pool.owns(req.rid):
+            pool.free(req.rid)
+        if pool.acquired_by(req.rid):
+            pool.release(req.rid)  # shared pages stay cached, refcount-1
         self.active[slot] = 0
         del self.running[slot]
         req.finish_time = when
@@ -378,6 +611,9 @@ class ServingEngine:
         self._admit(now)
         if not self.running:
             return []
+        # copy-on-write, re-checked per dispatch: the step is about to
+        # write every active row's block pos // block
+        self._validate_tables()
         out = self._step_fn(
             self.params, self._pool, jnp.asarray(self.logits),
             jnp.asarray(self.keys), jnp.asarray(self.pos),
@@ -433,13 +669,28 @@ class ServingEngine:
 
     # -- invariants ---------------------------------------------------
 
+    def check_conserved(self) -> None:
+        """Shard-by-shard pool partition + refcount check against the
+        LIVE block tables (serving/pool.check_conserved) — runnable at
+        any point, drained or not."""
+        for k in range(self.dp):
+            tabs = [self.tables[s] for s in sorted(self.running)
+                    if s // self.slots_per == k]
+            try:
+                self.pools[k].check_conserved(tabs)
+            except AssertionError as e:
+                raise AssertionError(f"shard {k}: {e}") from None
+
     def check_idle(self) -> None:
         """Drained-engine invariant (the CI smoke's leak gate): no
-        running requests and every shard pool fully free."""
+        running requests and every shard pool fully free — the prefix
+        caches spill their (necessarily unreferenced) pages first."""
         if self.running:
             raise AssertionError(f"requests still running: "
                                  f"{sorted(r.rid for r in self.running.values())}")
         for k, p in enumerate(self.pools):
+            if self.prefix_caches is not None:
+                self.prefix_caches[k].drop_unreferenced()
             try:
                 p.check_all_free()
             except AssertionError as e:

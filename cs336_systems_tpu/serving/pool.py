@@ -7,12 +7,29 @@ not in the free list at construction, ``alloc`` can never hand it out,
 and ``free`` rejects it — so a block table built from this allocator's
 ids satisfies models/decode.validate_block_tables by construction.
 
-Owner tracking is per request id: ``alloc(n, owner)`` binds n pages to
-the owner, ``free(owner)`` returns ALL of them at once (a finished
-request's pages come back in one move — the eviction contract), and
-``check_conserved()`` asserts the free list + owned sets partition the
-full page range, which is the leak check the CI smoke and every
-benchmark trace run after draining (ISSUE 8 acceptance criterion).
+Two ownership regimes (ISSUE 9 added the second):
+
+- PRIVATE pages: ``alloc(n, owner)`` binds n pages to one owner,
+  ``free(owner)`` returns ALL of them at once (a finished request's
+  pages come back in one move — the eviction contract).
+- SHARED pages: immutable prefix-cache pages referenced by any number of
+  block tables. ``alloc_shared``/``promote`` create a shared allocation
+  under a cache-entry ``tag`` with an explicit REFCOUNT per page;
+  ``acquire(pages, owner)`` bumps the refcounts when a block table takes
+  a reference, ``release(owner)`` drops them all at eviction, and
+  ``drop_shared(tag)`` returns the pages to the free list — legal ONLY
+  at refcount 0 (the prefix cache's LRU spill path). A shared page is
+  never written (copy-on-write is enforced one level up by
+  models/decode.validate_block_tables's read-only set), so sharing is
+  pure aliasing: N tables, one physical page.
+
+``check_conserved()`` asserts the free list + private owners + shared
+allocations exactly partition the page range — each shared page counted
+ONCE — and that every shared page's refcount equals the number of
+acquire records (and, when the caller passes the engine's live block
+tables, the number of tables that actually contain it). This is the
+leak/double-count check the CI smoke and every benchmark trace run after
+draining (ISSUE 8 + ISSUE 9 acceptance criteria).
 """
 
 from __future__ import annotations
@@ -35,19 +52,42 @@ class PagePool:
         self.scratch_page = n_pages  # array index of the reserved page
         self._free: list[int] = list(range(n_pages - 1, -1, -1))
         self._owned: dict[object, list[int]] = {}
+        # shared (prefix-cache) state: tag -> pages, page -> refcount,
+        # owner -> acquired shared pages (the block-table references)
+        self._shared: dict[object, list[int]] = {}
+        self._ref: dict[int, int] = {}
+        self._acquired: dict[object, list[int]] = {}
 
     @property
     def available(self) -> int:
         return len(self._free)
 
     def owned_by(self, owner) -> list[int]:
-        """The owner's pages, in block order (a copy)."""
+        """The owner's PRIVATE pages, in block order (a copy)."""
         return list(self._owned[owner])
 
+    def owns(self, owner) -> bool:
+        """True when ``owner`` holds a private allocation."""
+        return owner in self._owned
+
+    def acquired_by(self, owner) -> list[int]:
+        """The owner's acquired SHARED pages, in acquire order (a copy);
+        empty list for an owner with no acquire record."""
+        return list(self._acquired.get(owner, ()))
+
+    def shared_page_ids(self) -> set[int]:
+        """All pages currently in shared allocations — the read-only set
+        models/decode.validate_block_tables enforces copy-on-write with."""
+        return set(self._ref)
+
+    def refcount(self, page: int) -> int:
+        """Block-table references on a SHARED page (KeyError: not shared)."""
+        return self._ref[page]
+
     def alloc(self, n: int, owner) -> list[int]:
-        """Take ``n`` pages for ``owner``; returns them in block order.
-        All-or-nothing: raises without touching the free list when the
-        pool cannot satisfy the request (the scheduler then leaves the
+        """Take ``n`` PRIVATE pages for ``owner``; returns them in block
+        order. All-or-nothing: raises without touching the free list when
+        the pool cannot satisfy the request (the scheduler then leaves the
         request queued until an eviction frees enough pages)."""
         if n < 1:
             raise ValueError(f"alloc needs n >= 1, got {n}")
@@ -64,34 +104,170 @@ class PagePool:
         return list(pages)
 
     def free(self, owner) -> int:
-        """Return ALL of ``owner``'s pages to the free list; returns the
-        count. Raises on unknown owner (double free)."""
+        """Return ALL of ``owner``'s private pages to the free list;
+        returns the count. Raises on unknown owner (double free)."""
         if owner not in self._owned:
             raise KeyError(f"owner {owner!r} holds no pages (double free?)")
         pages = self._owned.pop(owner)
         self._free.extend(pages)
         return len(pages)
 
-    def check_conserved(self) -> None:
-        """Assert the free list and the owned sets exactly partition
-        [0, n_pages) — no leak, no duplication, no scratch intrusion."""
+    # -- shared (prefix-cache) pages ----------------------------------
+
+    def alloc_shared(self, n: int, tag) -> list[int]:
+        """Take ``n`` pages from the free list as a SHARED allocation
+        under ``tag``, refcount 0 (cached but unreferenced — spillable
+        until the first ``acquire``)."""
+        if n < 1:
+            raise ValueError(f"alloc_shared needs n >= 1, got {n}")
+        if tag in self._shared:
+            raise ValueError(f"shared tag {tag!r} already holds pages "
+                             f"{self._shared[tag]} (double alloc_shared)")
+        if n > len(self._free):
+            raise MemoryError(
+                f"pool exhausted: {n} shared pages requested, "
+                f"{len(self._free)} free of {self.n_pages}")
+        pages = [self._free.pop() for _ in range(n)]
+        self._shared[tag] = pages
+        for p in pages:
+            self._ref[p] = 0
+        return list(pages)
+
+    def promote(self, owner, pages: list[int], tag) -> None:
+        """Convert ``pages`` of ``owner``'s PRIVATE allocation into a
+        SHARED allocation under ``tag`` with refcount 1 — the publish
+        path: a completed prefill's full prefix pages become immutable
+        cache pages, and the publisher's block table keeps its reference
+        (recorded as an acquire, released at its eviction)."""
+        if tag in self._shared:
+            raise ValueError(f"shared tag {tag!r} already exists")
+        if owner not in self._owned:
+            raise KeyError(f"owner {owner!r} holds no private pages")
+        held = self._owned[owner]
+        for p in pages:
+            if p not in held:
+                raise ValueError(
+                    f"page {p} is not in owner {owner!r}'s private "
+                    f"allocation {held} — cannot promote")
+        remaining = [p for p in held if p not in pages]
+        if remaining:
+            self._owned[owner] = remaining
+        else:
+            del self._owned[owner]
+        self._shared[tag] = list(pages)
+        for p in pages:
+            self._ref[p] = 1
+        self._acquired.setdefault(owner, []).extend(pages)
+
+    def acquire(self, pages: list[int], owner) -> None:
+        """Bump the refcount of each SHARED page for a block table that
+        now references it. Raises on a page that is not shared (acquiring
+        a free/private page would alias mutable state) and on the same
+        owner acquiring the same page twice (its table would have to
+        contain the page twice)."""
+        mine = self._acquired.get(owner, [])
+        for p in pages:
+            if p not in self._ref:
+                raise ValueError(
+                    f"page {p} is not a shared page (acquire of "
+                    f"free/private page)")
+            if p in mine:
+                raise ValueError(
+                    f"owner {owner!r} already acquired shared page {p} "
+                    f"(double acquire)")
+        for p in pages:
+            self._ref[p] += 1
+        self._acquired.setdefault(owner, []).extend(pages)
+
+    def release(self, owner) -> int:
+        """Drop ALL of ``owner``'s shared-page references (eviction);
+        returns the count. Pages stay cached at refcount 0 until the
+        prefix cache spills them. Raises on an owner with no acquire
+        record (early/double release)."""
+        if owner not in self._acquired:
+            raise KeyError(
+                f"owner {owner!r} holds no shared references "
+                f"(double release?)")
+        pages = self._acquired.pop(owner)
+        for p in pages:
+            assert self._ref[p] > 0, f"refcount underflow on page {p}"
+            self._ref[p] -= 1
+        return len(pages)
+
+    def drop_shared(self, tag) -> int:
+        """Return a shared allocation's pages to the free list (the LRU
+        spill). Legal ONLY when every page's refcount is 0 — spilling a
+        referenced page would free memory a live block table points at."""
+        if tag not in self._shared:
+            raise KeyError(f"unknown shared tag {tag!r}")
+        pages = self._shared[tag]
+        for p in pages:
+            if self._ref[p]:
+                raise ValueError(
+                    f"shared page {p} (tag {tag!r}) still has "
+                    f"refcount {self._ref[p]} — cannot spill")
+        del self._shared[tag]
+        for p in pages:
+            del self._ref[p]
+        self._free.extend(pages)
+        return len(pages)
+
+    # -- invariants ---------------------------------------------------
+
+    def check_conserved(self, block_tables=None) -> None:
+        """Assert the free list, the private owners and the shared
+        allocations exactly partition [0, n_pages) — each shared page
+        counted ONCE — no leak, no duplication, no scratch intrusion;
+        and that each shared page's refcount equals its acquire-record
+        count. ``block_tables``: optional iterable of the ACTIVE
+        requests' page-id lists — when given, each shared page's
+        refcount must also equal the number of tables containing it
+        (the refcount == owning-block-tables contract)."""
         seen = list(self._free)
         for pages in self._owned.values():
             seen.extend(pages)
+        for pages in self._shared.values():
+            seen.extend(pages)
         if len(seen) != len(set(seen)):
-            raise AssertionError("page id duplicated across free/owned sets")
+            raise AssertionError("page id duplicated across free/owned/"
+                                 "shared sets")
         if set(seen) != set(range(self.n_pages)):
             missing = set(range(self.n_pages)) - set(seen)
             extra = set(seen) - set(range(self.n_pages))
             raise AssertionError(
                 f"pool not conserved: leaked={sorted(missing)} "
                 f"foreign={sorted(extra)}")
+        counts: dict[int, int] = {}
+        for pages in self._acquired.values():
+            for p in pages:
+                counts[p] = counts.get(p, 0) + 1
+        if counts != {p: r for p, r in self._ref.items() if r}:
+            raise AssertionError(
+                f"shared refcounts {self._ref} disagree with acquire "
+                f"records {counts}")
+        if block_tables is not None:
+            table_counts: dict[int, int] = {}
+            for table in block_tables:
+                for p in set(int(x) for x in table):
+                    if p in self._ref:
+                        table_counts[p] = table_counts.get(p, 0) + 1
+            for p, r in self._ref.items():
+                if table_counts.get(p, 0) != r:
+                    raise AssertionError(
+                        f"shared page {p}: refcount {r} but "
+                        f"{table_counts.get(p, 0)} block tables contain it")
 
     def check_all_free(self) -> None:
-        """Assert every page is back in the free list (a drained engine):
-        the CI smoke's no-leak gate."""
+        """Assert every page is back in the free list (a drained engine
+        whose prefix cache has been dropped): the CI smoke's no-leak
+        gate."""
         self.check_conserved()
         if self._owned:
             raise AssertionError(
                 f"pages still owned after drain: "
                 f"{ {k: v for k, v in self._owned.items()} }")
+        if self._shared:
+            raise AssertionError(
+                f"shared pages still cached after drain: "
+                f"{ {k: v for k, v in self._shared.items()} } — spill the "
+                "prefix cache before the all-free check")

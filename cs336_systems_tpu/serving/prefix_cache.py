@@ -1,0 +1,231 @@
+"""Prefix cache: a hash-chain trie over completed-prefill KV pages.
+
+The millions-of-users scenario is N concurrent requests sharing a system
+prompt: without this module the engine prefills and stores that prefix N
+times (N·P prefill FLOPs, N·P tokens of kv-cache HBM). The paged pool
+already separates logical rows from physical pages, so sharing is pure
+allocator/admission work: publish the FULL, page-aligned KV pages of a
+completed prefill into a trie keyed by the token-block hash chain, and
+let later block tables reference the same physical pages.
+
+Keying — ``(params fingerprint, page_block, token-block hash chain)``:
+node i's key is ``H(h_{i-1} ‖ tokens[i·blk : (i+1)·blk])`` with
+``h_{-1} = H(fingerprint ‖ blk)``, so a chain hash names the ENTIRE
+token prefix up to its block boundary (two prompts share node i iff
+their first (i+1)·blk tokens are identical), and caches built against
+different weights or page sizes can never collide.
+
+Copy-on-write contract (enforced downstream by
+models/decode.validate_block_tables's read-only set): only FULLY-filled
+page-aligned prompt blocks are published — the paged decode kernel
+writes block ``pos // blk``, which for any request is at or past block
+``plen // blk``, i.e. always a PRIVATE page. A request whose prompt
+diverges mid-block shares the full blocks before the divergence and
+owns the divergent partial block privately.
+
+Boundary logits: a publisher whose prompt ends exactly at a block
+boundary also stores its last-token logits on the final node — a later
+request with the identical full prompt then joins with ZERO prefill
+(pages acquired, logits replayed, position set). Without cached logits
+a full-chain match is capped one block short so the suffix prefill
+always has >= 1 token to produce the join logits from.
+
+Spill — the no-deadlock rule: unreferenced nodes (pool refcount 0) are
+evictable in LRU order, deepest-first within a chain, so admission can
+always reclaim cached-but-idle pages; referenced pages are never touched
+(a live block table points at them). Touch order makes a child's
+``last_used`` <= its parent's, so the (last_used, -depth) sort can never
+evict a parent before its children and the trie stays well-formed.
+
+Shard-locality: page ids are shard-local (parallel/serve.engine_specs),
+so the engine holds ONE PrefixCache per dp shard over that shard's
+PagePool; no page, hash or refcount ever crosses the mesh and prefix
+reuse adds ZERO collectives to any step program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+
+def params_fingerprint(params) -> bytes:
+    """Cheap content-sensitive digest of a param pytree: tree structure,
+    every leaf's shape/dtype, and the raw bytes of the (tiny) final-norm
+    leaves when present. KV pages are only valid against the weights
+    that produced them; the fingerprint domain-separates hash chains so
+    an engine restarted with different weights (or a future multi-model
+    pool) can never alias another model's pages. Not a cryptographic
+    identity of the full weights — the cache is engine-local and the
+    engine's params are fixed for its lifetime."""
+    import jax
+
+    h = hashlib.blake2b(digest_size=16)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        h.update(str(jax.numpy.shape(leaf)).encode())
+        h.update(str(getattr(leaf, "dtype", type(leaf))).encode())
+    ln = params.get("ln_final") if hasattr(params, "get") else None
+    if ln is not None:
+        for leaf in jax.tree_util.tree_leaves(ln):
+            h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.digest()
+
+
+@dataclasses.dataclass
+class _Node:
+    """One cached token block: the chain hash that names the token
+    prefix ending at this block, the physical page holding its KV, trie
+    links, and the LRU clock. ``logits``: the publisher's last-token
+    logits when its prompt ended exactly at this node's boundary (the
+    zero-prefill full-hit join), else None."""
+
+    h: bytes
+    parent: bytes | None
+    depth: int
+    page: int
+    last_used: int
+    logits: np.ndarray | None = None
+
+
+class PrefixCache:
+    """Trie of shared KV pages over one shard-local PagePool."""
+
+    def __init__(self, pool, page_block: int, fingerprint: bytes):
+        self.pool = pool
+        self.block = int(page_block)
+        self._root = hashlib.blake2b(
+            fingerprint + self.block.to_bytes(4, "little"),
+            digest_size=16).digest()
+        self._nodes: dict[bytes, _Node] = {}
+        self._clock = 0
+        # block-level telemetry for the benchmark columns
+        self.hit_blocks_total = 0
+        self.lookup_blocks_total = 0
+        self.spilled_pages_total = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def chain_hashes(self, prompt) -> list[bytes]:
+        """Chain hashes of the prompt's FULL blocks (``len // block`` of
+        them) — the publishable/hittable spine of the prompt."""
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        n = toks.size // self.block
+        out, h = [], self._root
+        for i in range(n):
+            blk = toks[i * self.block:(i + 1) * self.block]
+            h = hashlib.blake2b(h + blk.tobytes(), digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def lookup(self, prompt):
+        """Longest cached page-aligned prefix of ``prompt``.
+
+        Returns ``(hit_blocks, pages, logits)``: the number of matched
+        full blocks, their page ids in block order, and — ONLY when the
+        match covers the entire prompt exactly at a block boundary AND
+        the final node cached boundary logits — that logits row (the
+        zero-prefill join). Otherwise the hit is capped so at least one
+        prompt token remains for the suffix prefill. Touches the hit
+        path's LRU clocks. Does NOT acquire: the caller bumps refcounts
+        through the pool once it commits to the admission."""
+        hashes = self.chain_hashes(prompt)
+        plen = int(np.asarray(prompt).size)
+        self.lookup_blocks_total += len(hashes)
+        m, path = 0, []
+        for h in hashes:
+            node = self._nodes.get(h)
+            if node is None:
+                break
+            path.append(node)
+            m += 1
+        self._clock += 1
+        for node in path:
+            node.last_used = self._clock
+        logits = None
+        if m and m * self.block == plen:
+            if path[-1].logits is not None:
+                logits = path[-1].logits
+            else:
+                m -= 1  # keep >= 1 suffix token for the join logits
+                path.pop()
+        self.hit_blocks_total += m
+        return m, [n.page for n in path], logits
+
+    def publish(self, prompt, owner, pages_by_block: dict,
+                logits=None) -> int:
+        """Publish a completed prefill's full prompt blocks: for each
+        uncached chain node, PROMOTE the owner's private page for that
+        block into a shared page (refcount 1 — the publisher's own block
+        table keeps its reference). ``pages_by_block`` maps block index
+        -> the owner's private page id; blocks already cached (hit at
+        admission, or raced by an earlier publish) are skipped — the
+        owner's duplicate page, if any, simply stays private. ``logits``:
+        the request's last-token logits, stored on the final node when
+        the prompt ends exactly at a block boundary. Returns the number
+        of newly published pages."""
+        hashes = self.chain_hashes(prompt)
+        plen = int(np.asarray(prompt).size)
+        new = 0
+        self._clock += 1
+        parent = None
+        for i, h in enumerate(hashes):
+            node = self._nodes.get(h)
+            if node is None:
+                if i not in pages_by_block:
+                    break  # owner holds no private page for this block
+                page = pages_by_block[i]
+                self.pool.promote(owner, [page], h)
+                node = _Node(h, parent, i, page, self._clock)
+                self._nodes[h] = node
+                new += 1
+            else:
+                node.last_used = self._clock
+            parent = h
+        if (hashes and logits is not None
+                and len(hashes) * self.block == plen):
+            tail = self._nodes.get(hashes[-1])
+            if tail is not None and tail.logits is None:
+                tail.logits = np.array(logits, np.float32)
+        return new
+
+    def spillable_pages(self) -> int:
+        """Pages reclaimable right now (refcount-0 nodes) — what
+        admission adds to ``pool.available`` when deciding whether a
+        request CAN fit (the no-deadlock bound)."""
+        return sum(1 for n in self._nodes.values()
+                   if self.pool.refcount(n.page) == 0)
+
+    def spill(self, n_pages: int) -> int:
+        """Evict unreferenced nodes until ``n_pages`` pages returned to
+        the free list (or no candidates remain); returns the count.
+        Order: least-recently-used first, deepest-first within equal
+        clocks — a parent is never evicted before its children (see
+        module docstring), so the trie stays well-formed."""
+        if n_pages <= 0:
+            return 0
+        cand = [n for n in self._nodes.values()
+                if self.pool.refcount(n.page) == 0]
+        cand.sort(key=lambda n: (n.last_used, -n.depth))
+        freed = 0
+        for node in cand:
+            if freed >= n_pages:
+                break
+            self.pool.drop_shared(node.h)
+            del self._nodes[node.h]
+            freed += 1
+        self.spilled_pages_total += freed
+        return freed
+
+    def drop_unreferenced(self) -> int:
+        """Spill EVERY refcount-0 node (the drained-engine path before
+        ``PagePool.check_all_free``); returns pages freed."""
+        return self.spill(len(self._nodes))
+
+    def shared_pages(self) -> int:
+        """Number of pages currently held by the cache."""
+        return len(self._nodes)

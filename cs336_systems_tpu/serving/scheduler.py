@@ -7,6 +7,12 @@ free, and nothing behind it may bypass it — FIFO admission keeps the
 engine's stream assignment a pure function of the request set, which is
 what the bit-exactness-across-join-orders test leans on (every request's
 tokens depend only on its OWN row key chain, never on when it joined).
+
+Prefix-cache admission (ISSUE 9) keeps the FIFO contract: the head
+request may additionally wait for spillable cached pages, but it still
+blocks everything behind it; ``Request.prefix_hit_tokens`` records how
+many of its prompt tokens were served from shared pages instead of
+prefill (the benchmark's hit-rate column).
 """
 
 from __future__ import annotations
@@ -45,6 +51,7 @@ class Request:
         self.tokens: list[int] = []      # emitted stream
         self.finish_time: float | None = None
         self.emit_times: list[float] = []  # benchmark latency samples
+        self.prefix_hit_tokens: int = 0  # prompt tokens served from cache
 
 
 class Scheduler:

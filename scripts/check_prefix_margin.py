@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""CI gate: the shared-prefix engine family's analyzed memory must
+undercut its unshared twin by EXACTLY the analytic N·P−P page margin.
+
+memkit profiles ``serve_engine_prefix`` (registry geometry: dp8, 2
+slots/shard sharing one P=1-page prefix — 3 real pages + scratch per
+shard) and an UNSHARED twin of the same step at the same workload where
+every slot owns both its blocks privately (4 real pages + scratch).
+The twin's kv-cache bytes must exceed the prefix family's
+kv-shared + kv-private by (N·P − P) = 1 page per shard — per device,
+one page × page-bytes × layers — and the kv split itself must match the
+registry's declared fraction (memkit.SERVE_KV_SPLIT). Exact equality,
+not a threshold: both profiles come from the same liveness walk over
+the same program, so the ONLY difference is the pool geometry; any
+drift means the engine step started copying or double-buffering pages.
+
+Run (CPU mesh): scripts/run_tests_and_package.sh invokes this inside
+the prefix-cache gate.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import jax
+import jax.numpy as jnp
+
+from cs336_systems_tpu.analysis import memkit
+from cs336_systems_tpu.analysis.registry import (
+    _abstract_params,
+    _tiny_cfg,
+    serve_engine_prefix_geometry,
+    serve_engine_prefix_state,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.serving.engine import make_engine_step
+
+
+def _twin_profile():
+    """The unshared twin: same engine step, same slot state, but every
+    slot's two blocks are PRIVATE pages — 2 slots/shard × 2 pages + the
+    scratch page per shard."""
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    slots, _, _, blk = serve_engine_prefix_geometry()
+    step = make_engine_step(cfg, blk, mesh=mesh, dp_axis="dp",
+                            temperature=0.9, top_k=8, donate=False)
+    params = _abstract_params(cfg)
+    state = list(serve_engine_prefix_state())
+    twin_pages = 2 * (slots // mesh.size)
+    state[-1] = jnp.tile(jnp.asarray([[0, 1], [2, 3]], jnp.int32),
+                         (slots // 2, 1))
+    pool = tuple(jax.ShapeDtypeStruct(
+        (mesh.size * (twin_pages + 1), cfg.num_heads, blk,
+         2 * cfg.d_head), cfg.cdtype) for _ in range(cfg.num_layers))
+    args = (params, pool) + tuple(state)
+    arg_cls = memkit._leaf_classes(
+        args, memkit.ARG_CLASSES["serve_engine_prefix"])
+    return memkit.profile_callable(
+        step, args, family="serve_engine_prefix_unshared",
+        arg_classes=arg_cls, n_devices=mesh.size)
+
+
+def main() -> int:
+    cfg = _tiny_cfg()
+    _, pages, _, blk = serve_engine_prefix_geometry()
+    shared_frac, total_frac = memkit.SERVE_KV_SPLIT["serve_engine_prefix"]
+    # per-device bytes of ONE page across all layers — the N·P−P margin
+    # at N=2 slots/shard, P=1 prefix page
+    page_bytes = (cfg.num_heads * blk * 2 * cfg.d_head
+                  * jnp.dtype(cfg.cdtype).itemsize * cfg.num_layers)
+
+    shared = memkit.profile_family("serve_engine_prefix")
+    twin = _twin_profile()
+
+    comp = shared["composition_bytes"]
+    fails = []
+    if "kv-cache" in comp:
+        fails.append("serve_engine_prefix still reports a raw kv-cache "
+                     "class — SERVE_KV_SPLIT did not apply")
+    kv_sh = comp.get("kv-shared", 0)
+    kv_pr = comp.get("kv-private", 0)
+    kv_total = kv_sh + kv_pr
+    if kv_sh != kv_total * shared_frac // total_frac:
+        fails.append(
+            f"kv-shared {kv_sh} != declared {shared_frac}/{total_frac} "
+            f"fraction of kv total {kv_total}")
+    twin_kv = twin["composition_bytes"].get("kv-cache", 0)
+    margin = twin_kv - kv_total
+    if margin != page_bytes:
+        fails.append(
+            f"unshared-twin kv margin {margin} B/device != analytic "
+            f"N·P−P = {page_bytes} B/device (1 page × {cfg.num_layers} "
+            f"layers); twin kv {twin_kv}, shared kv {kv_total}")
+    if shared["peak_bytes"] >= twin["peak_bytes"]:
+        fails.append(
+            f"shared peak {shared['peak_bytes']} not below twin peak "
+            f"{twin['peak_bytes']} — the shared pool saved nothing")
+
+    print(f"prefix-margin: shared kv {kv_sh}+{kv_pr}={kv_total} B/dev, "
+          f"twin kv {twin_kv} B/dev, margin {margin} B/dev "
+          f"(analytic {page_bytes}), peaks {shared['peak_bytes']} vs "
+          f"{twin['peak_bytes']}")
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,45 @@ if [ "$engine_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$engine_status
 
+# prefix-cache gate (ISSUE 9): the shared-prefix engine family through
+# both analysis pipelines (the step program must stay byte-identical to
+# serve_engine's — lint pins the decode-only collective contract
+# verbatim, so prefix reuse adds ZERO collectives), the analytic N·P−P
+# memory margin vs the unshared twin (scripts/check_prefix_margin.py,
+# exact equality over memkit's kv-shared/kv-private split), then a
+# poisson smoke WITH a shared system prompt through the real engine
+# loop — requests must complete with a non-trivial prefix_hit_rate and
+# the drain must leave every page free (run_cell's check_idle spills
+# the cache and runs PagePool.check_all_free; a leak fails the cell).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.trace_cli --step serve_engine_prefix \
+    --iters 1 --out /tmp/prefix_smoke.stepprofile.json
+prefix_status=$?
+if [ "$prefix_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_prefix_margin.py
+    prefix_status=$?
+fi
+if [ "$prefix_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.benchmarks.serving --test-model \
+        --requests 10 --loads 20 --new 6 --shared-prefix 16 \
+        --profiles uniform zipf spike --out /tmp/prefix_smoke.jsonl
+    prefix_status=$?
+fi
+if [ "$prefix_status" -eq 0 ]; then
+    # the smoke must actually exercise sharing: every cell's hit rate > 0
+    python - <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open("/tmp/prefix_smoke.jsonl")]
+bad = [r["name"] for r in rows if r["prefix_hit_rate"] <= 0
+       or r["shared_kv_bytes"] <= 0]
+sys.exit(1 if bad or not rows else 0)
+EOF
+    prefix_status=$?
+fi
+[ "$status" -eq 0 ] && status=$prefix_status
+
 # gradsan gate: the differential numerics sanitizer on the two composed
 # families whose parity regression it root-caused (the a2a grad sync and
 # the sp/dp flat sync — parallel/ep.py, parallel/sp.py): the sharded

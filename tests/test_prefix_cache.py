@@ -1,0 +1,435 @@
+"""Prefix-cache tests (ISSUE 9): refcounted shared KV pages +
+copy-on-write in the serving engine.
+
+Three layers, matching the subsystem's stack:
+
+- ``serving/pool.py`` shared-page regime: property-style checks that
+  double-acquire / early-free / refcount-vs-table drift / spill-while-
+  referenced all fail loud, and that ``check_conserved`` counts each
+  shared page ONCE against the partition.
+- ``serving/prefix_cache.py`` trie: chain-hash prefix property and
+  fingerprint domain separation, the lookup cap that keeps >= 1 suffix
+  token unless boundary logits are cached, publish-skip of already-
+  cached blocks, and LRU spill order (a parent is never evicted before
+  its children).
+- The ENGINE contract: with the cache on, streams are bit-identical to
+  the unshared engine AND the row-keyed oracle across join orders and
+  on dp8 / dp2×tp4 meshes; N=8 requests sharing a P=4·page_block prefix
+  allocate exactly P/page_block shared pages once (not N×) and prefill
+  only the uncached tails; mid-block divergence takes the COW path; and
+  pool pressure forces LRU spill without deadlocking admission — with
+  ``check_conserved``/``check_all_free`` passing after every drain.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.models.decode import (
+    generate_kv_batched,
+    validate_block_tables,
+)
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.serving import (
+    PagePool,
+    PrefixCache,
+    Request,
+    ServingEngine,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 6
+PREFIX_BLOCKS = 4                      # the acceptance shape: P = 4·BLK
+TAIL_LENS = [3, 5, 7, 2, 6, 4, 1, 7]   # all < BLK: only the prefix is
+#                                        ever published as shared pages
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    """8 prompts sharing a P=4·BLK-token prefix with distinct sub-block
+    tails — the millions-of-users acceptance shape."""
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(0, CFG.vocab_size, PREFIX_BLOCKS * BLK)
+    return [np.concatenate([prefix, rng.integers(0, CFG.vocab_size, n)])
+            .astype(np.int32) for n in TAIL_LENS]
+
+
+def _oracle(params, prompts):
+    """All rows in ONE row-keyed paged batch — the stream every engine
+    (shared or not) must reproduce per request."""
+    pmax = max(p.size for p in prompts)
+    padded = np.zeros((len(prompts), pmax), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :p.size] = p
+    return generate_kv_batched(
+        params, CFG, padded, NEW, jax.random.PRNGKey(0), temperature=0.9,
+        top_k=8, row_keyed=True, prompt_lens=[p.size for p in prompts],
+        page_block=BLK)
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=8, n_pages=64,
+                max_blocks=6, page_block=BLK, temperature=0.9, top_k=8)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+def _run(eng, reqs):
+    for r in reqs:
+        eng.submit(r)
+    tick = iter(np.arange(0.0, 1e5, 0.5))
+    res = eng.run(time_fn=lambda: next(tick))
+    eng.check_conserved()
+    eng.check_idle()
+    return res
+
+
+# --- PagePool shared-page regime ---------------------------------------
+
+
+class TestSharedPool:
+    def test_shared_lifecycle_and_refcounts(self):
+        pool = PagePool(4)
+        pages = pool.alloc_shared(2, "tag")
+        assert all(pool.refcount(p) == 0 for p in pages)
+        pool.check_conserved()
+        pool.acquire(pages, "r1")
+        pool.acquire(pages, "r2")
+        assert all(pool.refcount(p) == 2 for p in pages)
+        pool.check_conserved(block_tables=[pages, pages])
+        assert pool.release("r1") == 2
+        assert all(pool.refcount(p) == 1 for p in pages)
+        pool.release("r2")
+        assert pool.drop_shared("tag") == 2
+        pool.check_all_free()
+
+    def test_double_acquire_raises(self):
+        pool = PagePool(2)
+        pages = pool.alloc_shared(1, "t")
+        pool.acquire(pages, "r")
+        with pytest.raises(ValueError, match="double acquire"):
+            pool.acquire(pages, "r")
+        pool.release("r")
+        pool.drop_shared("t")
+        pool.check_all_free()
+
+    def test_early_and_double_release_raise(self):
+        pool = PagePool(2)
+        pages = pool.alloc_shared(1, "t")
+        with pytest.raises(KeyError, match="release"):
+            pool.release("ghost")
+        pool.acquire(pages, "r")
+        pool.release("r")
+        with pytest.raises(KeyError, match="release"):
+            pool.release("r")
+
+    def test_acquire_of_unshared_page_raises(self):
+        pool = PagePool(4)
+        priv = pool.alloc(1, "a")
+        with pytest.raises(ValueError, match="not a shared page"):
+            pool.acquire(priv, "r")          # private page
+        with pytest.raises(ValueError, match="not a shared page"):
+            pool.acquire([pool._free[-1]], "r")  # free page
+
+    def test_spill_while_referenced_raises(self):
+        pool = PagePool(2)
+        pages = pool.alloc_shared(1, "t")
+        pool.acquire(pages, "r")
+        with pytest.raises(ValueError, match="refcount"):
+            pool.drop_shared("t")
+        pool.release("r")
+        pool.drop_shared("t")
+
+    def test_promote_records_publisher_reference(self):
+        pool = PagePool(4)
+        priv = pool.alloc(3, "owner")
+        pool.promote("owner", priv[:2], "t")
+        assert pool.owned_by("owner") == priv[2:]
+        assert pool.acquired_by("owner") == priv[:2]
+        assert all(pool.refcount(p) == 1 for p in priv[:2])
+        # the owner's block table holds promoted + remaining-private
+        pool.check_conserved(block_tables=[priv])
+        with pytest.raises(ValueError, match="cannot promote"):
+            pool.promote("owner", [priv[0]], "t2")  # no longer private
+        pool.free("owner")
+        pool.release("owner")
+        pool.drop_shared("t")
+        pool.check_all_free()
+
+    def test_refcount_vs_table_drift_detected(self):
+        pool = PagePool(4)
+        pages = pool.alloc_shared(1, "t")
+        pool.acquire(pages, "r")
+        with pytest.raises(AssertionError, match="block tables"):
+            pool.check_conserved(block_tables=[[3]])  # table lost the page
+
+    def test_shared_counted_once_and_drain_gate(self):
+        pool = PagePool(4)
+        pages = pool.alloc_shared(2, "t")
+        pool.acquire(pages, "r1")
+        pool.acquire(pages, "r2")
+        pool.check_conserved()               # 2 pages, counted once
+        assert pool.available == 2
+        pool.release("r1")
+        pool.release("r2")
+        with pytest.raises(AssertionError, match="spill the prefix cache"):
+            pool.check_all_free()            # cached-but-unreferenced
+        pool.drop_shared("t")
+        pool.check_all_free()
+
+
+# --- PrefixCache trie --------------------------------------------------
+
+
+def _publish(cache, pool, prompt, owner, logits=None):
+    """Simulate a completed prefill: private pages for every FULL block,
+    then publish them."""
+    n = len(prompt) // cache.block
+    pages = pool.alloc(max(n, 1), owner)
+    cache.publish(prompt, owner, dict(enumerate(pages[:n])), logits=logits)
+    return pages
+
+
+class TestPrefixTrie:
+    def test_chain_hash_prefix_property_and_fingerprint(self):
+        a = PrefixCache(PagePool(4), BLK, b"fp-a")
+        b = PrefixCache(PagePool(4), BLK, b"fp-b")
+        p1 = np.arange(3 * BLK + 2)
+        p2 = np.concatenate([p1[:2 * BLK], 63 - p1[2 * BLK:]])
+        h1, h2 = a.chain_hashes(p1), a.chain_hashes(p2)
+        assert len(h1) == 3 and len(h2) == 3       # full blocks only
+        assert h1[:2] == h2[:2] and h1[2] != h2[2]  # shared-prefix spine
+        assert a.chain_hashes(p1) != b.chain_hashes(p1)  # model-keyed
+
+    def test_lookup_caps_full_aligned_hit_without_logits(self):
+        pool = PagePool(8)
+        cache = PrefixCache(pool, BLK, b"fp")
+        prompt = np.arange(2 * BLK, dtype=np.int32)
+        _publish(cache, pool, prompt, "r0")
+        hit, pages, logits = cache.lookup(prompt)
+        assert (hit, len(pages), logits) == (1, 1, None)  # >= 1 token left
+        hit, pages, _ = cache.lookup(np.concatenate([prompt, [5]]))
+        assert hit == 2 and len(pages) == 2        # unaligned: full hit
+
+    def test_boundary_logits_enable_full_hit(self):
+        pool = PagePool(8)
+        cache = PrefixCache(pool, BLK, b"fp")
+        prompt = np.arange(2 * BLK, dtype=np.int32)
+        row = np.full(CFG.vocab_size, 0.5, np.float32)
+        _publish(cache, pool, prompt, "r0", logits=row)
+        hit, pages, logits = cache.lookup(prompt)
+        assert hit == 2 and len(pages) == 2
+        np.testing.assert_array_equal(logits, row)
+
+    def test_publish_skips_cached_blocks(self):
+        pool = PagePool(8)
+        cache = PrefixCache(pool, BLK, b"fp")
+        prompt = np.arange(2 * BLK, dtype=np.int32)
+        _publish(cache, pool, prompt, "r0")
+        assert len(cache) == 2
+        # r1 prefilled the same prompt before r0's publish landed: its
+        # duplicate pages stay private, nothing new enters the trie
+        pages = pool.alloc(2, "r1")
+        assert cache.publish(prompt, "r1", dict(enumerate(pages))) == 0
+        assert len(cache) == 2 and pool.owned_by("r1") == pages
+
+    def test_spill_lru_order_keeps_trie_well_formed(self):
+        pool = PagePool(16)
+        cache = PrefixCache(pool, BLK, b"fp")
+        old = np.arange(3 * BLK, dtype=np.int32)
+        new = 63 - old
+        _publish(cache, pool, old, "r0")
+        _publish(cache, pool, new, "r1")
+        for r in ("r0", "r1"):
+            pool.release(r)                  # publishers evicted
+        assert cache.spillable_pages() == 6
+        assert cache.spill(2) == 2
+        # LRU: the OLD chain spilled first, deepest node first — every
+        # remaining node's parent is still present (well-formed trie)
+        hashes = {n.h for n in cache._nodes.values()}
+        for n in cache._nodes.values():
+            assert n.parent is None or n.parent in hashes
+        hit, _, _ = cache.lookup(np.concatenate([new, [1]]))
+        assert hit == 3                      # the recent chain survived
+        assert cache.drop_unreferenced() == 4
+        pool.check_all_free()
+
+
+# --- copy-on-write validation (models/decode) --------------------------
+
+
+def test_validate_block_tables_rejects_shared_write():
+    tables = np.array([[0, 1], [0, 2]], np.int32)
+    ro = {0}
+    # write block pos // BLK = 1 for both rows: private pages 1/2 — ok
+    validate_block_tables(tables, n_pages=4, read_only=ro,
+                          write_pos=np.array([10, 12]), block=BLK,
+                          active=np.array([1, 1]))
+    # row 1 rewound into the shared block: COW violation
+    with pytest.raises(ValueError, match="read-only"):
+        validate_block_tables(tables, n_pages=4, read_only=ro,
+                              write_pos=np.array([10, 4]), block=BLK,
+                              active=np.array([1, 1]))
+    # the same position on an INACTIVE row writes only scratch — ok
+    validate_block_tables(tables, n_pages=4, read_only=ro,
+                          write_pos=np.array([10, 4]), block=BLK,
+                          active=np.array([1, 0]))
+
+
+# --- engine: accounting + bit-exactness --------------------------------
+
+
+def test_shared_prefix_page_accounting(params, prompts):
+    """THE acceptance criterion: N=8 requests sharing P=4·BLK tokens →
+    exactly P/BLK shared pages allocated ONCE, prefill only on uncached
+    tails, every later request's hit recorded on the request."""
+    eng = _engine(params, prefix_cache=True)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=NEW)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        eng.submit(r)
+    tick = iter(np.arange(0.0, 1e5, 0.5))
+    eng.run(time_fn=lambda: next(tick))
+    eng.check_conserved()
+    P = PREFIX_BLOCKS * BLK
+    total_prompt = sum(p.size for p in prompts)
+    # sub-block tails: the trie holds exactly the P/BLK prefix pages
+    assert sum(len(c) for c in eng.prefix_caches) == PREFIX_BLOCKS
+    assert eng.shared_kv_bytes_peak == PREFIX_BLOCKS * eng._page_bytes
+    # one publisher prefilled the prefix; the other 7 hit all 4 blocks
+    assert eng.prefix_hit_tokens == (len(prompts) - 1) * P
+    assert eng.prefill_tokens == total_prompt - (len(prompts) - 1) * P
+    assert eng.prefix_prompt_tokens == total_prompt
+    hits = sorted(r.prefix_hit_tokens for r in reqs)
+    assert hits == [0] + [P] * (len(prompts) - 1)
+    eng.check_idle()                         # drops the cache, all free
+
+
+@pytest.mark.parametrize("order", [
+    list(range(8)),
+    [5, 2, 7, 0, 3, 6, 1, 4],
+    [7, 6, 5, 4, 3, 2, 1, 0],
+], ids=["fifo", "shuffled", "reversed"])
+def test_streams_bit_identical_across_join_orders(params, prompts, order):
+    """Shared-prefix engine == unshared engine == row-keyed oracle, for
+    every join order (staggered arrivals, half the slots so requests
+    queue and join mid-flight into shared pages)."""
+    want = np.asarray(_oracle(params, prompts))
+    base = _run(_engine(params, prefix_cache=False),
+                [Request(rid=r, prompt=prompts[r], max_new_tokens=NEW)
+                 for r in range(len(prompts))])
+    eng = _engine(params, slots=4, n_pages=32, prefix_cache=True)
+    res = _run(eng, [Request(rid=r, prompt=prompts[r], max_new_tokens=NEW,
+                             arrival=float(i) * 0.25)
+                     for i, r in enumerate(order)])
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+        np.testing.assert_array_equal(res[r], base[r])
+    assert eng.prefix_hit_tokens > 0         # sharing actually happened
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+], ids=["dp8", "dp2xtp4"])
+def test_streams_bit_identical_on_mesh(params, prompts, mesh_axes, dp, tp):
+    """Shard-local prefix caches over shard-local pools: staggered
+    shuffled arrivals on dp8 and dp2×tp4 still stream the oracle rows.
+    TWO waves of the same prompts (wave 2 with ``row`` mapped back to
+    the oracle rows): on dp8's one-slot shards sharing only happens
+    ACROSS waves, so this also pins that a shard's cache survives its
+    publisher's eviction and that hits land on every shard."""
+    want = np.asarray(_oracle(params, prompts))
+    eng = _engine(params, n_pages=8, mesh=make_mesh(mesh_axes),
+                  dp_axis=dp, tp_axis=tp, prefix_cache=True)
+    n = len(prompts)
+    reqs = [Request(rid=w * n + r, prompt=prompts[r], max_new_tokens=NEW,
+                    row=r, arrival=float(w * n + i) * 0.25)
+            for w in range(2)
+            for i, r in enumerate([4, 1, 6, 0, 7, 2, 5, 3])]
+    res = _run(eng, reqs)
+    for w in range(2):
+        for r in range(n):
+            np.testing.assert_array_equal(res[w * n + r], want[r])
+    assert eng.prefix_hit_tokens > 0
+
+
+def test_cow_midblock_divergence(params, prompts):
+    """A prompt that diverges INSIDE a published block shares only the
+    blocks before the divergence; the divergent partial block is private
+    (COW) and the stream still matches the unshared engine."""
+    base_prompt = prompts[0]                 # prefix + 3-token tail
+    mid = np.concatenate([base_prompt[:PREFIX_BLOCKS * BLK - 4],
+                          (63 - base_prompt[PREFIX_BLOCKS * BLK - 4:
+                                            PREFIX_BLOCKS * BLK + 2])])
+    pair = [base_prompt, mid.astype(np.int32)]
+    want = _run(_engine(params, prefix_cache=False),
+                [Request(rid=i, prompt=p, max_new_tokens=NEW)
+                 for i, p in enumerate(pair)])
+    eng = _engine(params, prefix_cache=True)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=NEW,
+                    arrival=float(i)) for i, p in enumerate(pair)]
+    res = _run(eng, reqs)
+    for i in range(2):
+        np.testing.assert_array_equal(res[i], want[i])
+    # diverged 4 tokens into block 3: only blocks 0..2 hit
+    assert reqs[1].prefix_hit_tokens == (PREFIX_BLOCKS - 1) * BLK
+
+
+def test_boundary_logits_join_with_zero_prefill(params, prompts):
+    """Identical prompt ending exactly at a block boundary: the second
+    request replays the publisher's boundary logits and joins with ZERO
+    prefill — and still streams the unshared engine's tokens."""
+    prompt = prompts[0][:PREFIX_BLOCKS * BLK]  # block-aligned
+    pair = [Request(rid=i, prompt=prompt, max_new_tokens=NEW,
+                    arrival=float(i)) for i in range(2)]
+    want = _run(_engine(params, prefix_cache=False),
+                [Request(rid=i, prompt=prompt, max_new_tokens=NEW)
+                 for i in range(2)])
+    eng = _engine(params, prefix_cache=True)
+    res = _run(eng, pair)
+    for i in range(2):
+        np.testing.assert_array_equal(res[i], want[i])
+    assert eng.prefill_tokens == prompt.size   # paid once, not twice
+    assert pair[1].prefix_hit_tokens == prompt.size
+
+
+def test_lru_spill_under_pool_pressure(params):
+    """Two prefix families through a pool too small to cache both:
+    admission spills the LRU prefix instead of deadlocking, streams stay
+    bit-identical to the unshared engine, and the drain leaves every
+    page free."""
+    rng = np.random.default_rng(11)
+    fam_a = rng.integers(0, CFG.vocab_size, 2 * BLK)
+    fam_b = rng.integers(0, CFG.vocab_size, 2 * BLK)
+    reqs = []
+    for i, fam in enumerate([fam_a, fam_a, fam_b, fam_b, fam_a, fam_b]):
+        tail = rng.integers(0, CFG.vocab_size, 3)
+        reqs.append(np.concatenate([fam, tail]).astype(np.int32))
+    make = lambda: [Request(rid=i, prompt=p, max_new_tokens=NEW,
+                            arrival=float(i)) for i, p in enumerate(reqs)]
+    want = _run(_engine(params, prefix_cache=False, slots=1, n_pages=4,
+                        max_blocks=4), make())
+    # 4 pages/request (2 prefix + tail + growth), 5-page pool: caching a
+    # 2-page prefix leaves 3 free — the next foreign-prefix request MUST
+    # spill the cached family to fit
+    eng = _engine(params, prefix_cache=True, slots=1, n_pages=5,
+                  max_blocks=4)
+    res = _run(eng, make())
+    for i in range(len(reqs)):
+        np.testing.assert_array_equal(res[i], want[i])
+    assert sum(c.spilled_pages_total for c in eng.prefix_caches) > 0
