@@ -416,6 +416,20 @@ def serve_engine_prefix_geometry():
     return 16, 3, 2, SERVE_PAGED_BLOCK
 
 
+def serve_chaos_geometry():
+    """Registry geometry for the servesan chaos harness
+    (serving/chaos.py): ``(slots, n_pages, max_blocks, page_block)``.
+    8 slots over a generous 24-page-per-shard pool so the standard
+    multi-join/evict trace (8 requests sharing one full prefix block,
+    distinct tails, varied max_new) fits on every mesh the harness
+    runs — single-device (one 8-slot shard needs ~17 pages), dp8 (one
+    slot per shard) and dp2×tp4 (four slots per shard). max_blocks=3
+    covers the longest request (12-token prompt + 7 new at 8-row
+    pages). Shared with tests/test_serving_robustness.py so the trace
+    shape cannot drift."""
+    return 8, 24, 3, SERVE_PAGED_BLOCK
+
+
 def serve_engine_prefix_state(concrete: bool = False):
     """The serve_engine_prefix step's argument bundle — same layout as
     ``serve_engine_state`` at the prefix geometry. Concrete state is

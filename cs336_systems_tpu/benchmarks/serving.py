@@ -19,6 +19,22 @@ short), ``spike`` = all but one at P/8 plus one straggler at P. Skewed
 profiles are where the page pool earns its keep — short requests join
 and leave while a straggler holds its slot.
 
+``--deadline-ms D`` (ISSUE 10) attaches ``deadline = arrival + D`` to
+every request and turns each cell into an admission-control A/B: the
+same seeded arrivals run once under strict FIFO (nothing shed — the
+baseline that serves doomed requests anyway) and once under
+``DeadlinePolicy`` (earliest-deadline-first order, queue-expired
+requests shed with the retriable typed ``DeadlineExceeded``). The row
+gains ``fifo_goodput_tok_s`` / ``shed_goodput_tok_s`` (tokens from
+requests that finished BY their deadline, per second of makespan),
+``reject_rate`` (shed fraction) and ``p99_shed_ms`` (p99 per-token
+latency among the survivors). Under overload the shed columns must
+beat the FIFO baseline — that ordering is the point, and
+tests/test_serving_robustness.py pins it on a virtual clock.
+``--chaos-smoke`` runs the servesan fault matrix
+(serving/chaos.py, forced-CPU subprocess) before the sweep and aborts
+on any missed detection.
+
 ``--shared-prefix P`` (ISSUE 9) prepends a common P-token system prompt
 to every request: the engine's prefix cache pays that prefix's prefill
 and KV pages ONCE per trace instead of once per request, and the cell
@@ -47,6 +63,9 @@ Run: ``python -m cs336_systems_tpu.benchmarks.serving --test-model
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -62,7 +81,12 @@ from cs336_systems_tpu.models.transformer import (
     config_for_size,
     init_transformer_lm,
 )
-from cs336_systems_tpu.serving import Request, ServingEngine
+from cs336_systems_tpu.serving import (
+    DeadlinePolicy,
+    Request,
+    ServingEngine,
+    ServingError,
+)
 from cs336_systems_tpu.utils.timing import emit_row, print_table, results_table
 
 
@@ -82,7 +106,8 @@ def profile_lens(profile: str, n: int, prompt_len: int) -> np.ndarray:
 
 def build_requests(profile: str, n: int, prompt_len: int, new_tokens: int,
                    load_rps: float, vocab: int, seed: int,
-                   shared_prefix: int = 0) -> list[Request]:
+                   shared_prefix: int = 0,
+                   deadline_ms: float = 0.0) -> list[Request]:
     """Poisson arrivals: exponential inter-arrival gaps at ``load_rps``.
 
     ``shared_prefix``: prepend a common P-token system prompt to every
@@ -101,7 +126,9 @@ def build_requests(profile: str, n: int, prompt_len: int, new_tokens: int,
         Request(rid=i,
                 prompt=np.concatenate(
                     [prefix, rng.integers(0, vocab, size=int(lens[i]))]),
-                max_new_tokens=new_tokens, arrival=float(arrivals[i]))
+                max_new_tokens=new_tokens, arrival=float(arrivals[i]),
+                deadline=(float(arrivals[i]) + deadline_ms / 1e3
+                          if deadline_ms > 0 else None))
         for i in range(n)
     ]
 
@@ -113,18 +140,30 @@ def run_cell(engine: ServingEngine, requests: list[Request],
     Per-token latency samples: a request's first sample is time-to-first-
     token (first emit − arrival), the rest are inter-token gaps. p50/p99
     are over ALL token samples in the trace; goodput counts only tokens
-    from requests whose MEAN per-token latency met the SLO."""
+    from requests whose MEAN per-token latency met the SLO.
+
+    ISSUE 10: a request may also end in ``engine.failed`` (shed by the
+    admission policy with a retriable typed error) — every request must
+    be accounted for exactly once across completed/shed, every shedding
+    error must be a retriable ServingError, and ``deadline_goodput_tok_s``
+    counts only tokens from requests that finished BY their deadline (=
+    plain goodput when no request carries one)."""
     for r in requests:
         engine.submit(r)
     t0 = time.monotonic()
     results = engine.run()
     engine.check_idle()  # pool conservation: the no-leak gate
 
-    assert set(results) == {r.rid for r in requests}, "requests lost"
-    samples, good_tokens, total_tokens, ttfts = [], 0, 0, []
+    done, shed = set(results), set(engine.failed)
+    assert done | shed == {r.rid for r in requests}, "requests lost"
+    assert not done & shed, "request both completed and shed"
+    for err in engine.failed.values():
+        assert isinstance(err, ServingError) and err.retriable, \
+            f"shed with a non-retriable error: {type(err).__name__}"
+    samples, good_tokens, dl_tokens, total_tokens, ttfts = [], 0, 0, 0, []
     t_end = 0.0
     for r in requests:
-        if not r.emit_times:      # finished at EOS before emitting
+        if r.rid not in done or not r.emit_times:  # shed / EOS-at-once
             continue
         lat = np.diff([r.arrival] + r.emit_times)
         samples.extend(lat.tolist())
@@ -132,11 +171,16 @@ def run_cell(engine: ServingEngine, requests: list[Request],
         total_tokens += len(r.tokens)
         if float(lat.mean()) * 1e3 <= slo_ms:
             good_tokens += len(r.tokens)
+        if r.deadline is None or r.finish_time <= r.deadline:
+            dl_tokens += len(r.tokens)
         t_end = max(t_end, r.finish_time)
     makespan = max(t_end - min(r.arrival for r in requests), 1e-9)
     samples = np.asarray(samples) if samples else np.zeros(1)
     return {
         "completed": len(results),
+        "shed": len(shed),
+        "reject_rate": round(len(shed) / max(len(requests), 1), 4),
+        "deadline_goodput_tok_s": round(dl_tokens / makespan, 2),
         "tokens": total_tokens,
         "steps": engine.steps,
         "makespan_s": round(makespan, 4),
@@ -161,34 +205,57 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
           prompt_len: int, new_tokens: int, slots: int, n_pages: int,
           max_blocks: int, page_block: int, dp: int, seed: int,
           slo_ms: float, out_path: str | None, shared_prefix: int = 0,
-          prefix_cache: bool = True) -> list[dict]:
+          prefix_cache: bool = True,
+          deadline_ms: float = 0.0) -> list[dict]:
     params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
     mesh = dp_axis = None
     if dp:
         from cs336_systems_tpu.parallel.mesh import make_mesh
 
         mesh, dp_axis = make_mesh({"dp": dp}), "dp"
+
+    def make_engine(policy=None):
+        t0 = time.monotonic()
+        # fresh engine per run: the trace starts at clock 0 with a cold
+        # pool, so cells (and the deadline A/B twins) are independent
+        # and replayable
+        return ServingEngine(
+            params, cfg, key=jax.random.PRNGKey(0), slots=slots,
+            n_pages=n_pages, max_blocks=max_blocks,
+            page_block=page_block, temperature=0.9, top_k=8,
+            mesh=mesh, dp_axis=dp_axis, prefix_cache=prefix_cache,
+            policy=policy, clock=lambda: time.monotonic() - t0)
+
     rows = []
     for load in loads:
         for profile in profiles:
-            t0 = time.monotonic()
-            # fresh engine per cell: the trace starts at clock 0 with a
-            # cold pool, so cells are independent and replayable
-            engine = ServingEngine(
-                params, cfg, key=jax.random.PRNGKey(0), slots=slots,
-                n_pages=n_pages, max_blocks=max_blocks,
-                page_block=page_block, temperature=0.9, top_k=8,
-                mesh=mesh, dp_axis=dp_axis, prefix_cache=prefix_cache,
-                clock=lambda: time.monotonic() - t0)
-            reqs = build_requests(profile, n_requests, prompt_len,
-                                  new_tokens, load, cfg.vocab_size, seed,
-                                  shared_prefix)
+            def make_requests():
+                return build_requests(profile, n_requests, prompt_len,
+                                      new_tokens, load, cfg.vocab_size,
+                                      seed, shared_prefix, deadline_ms)
+
             row = {"name": f"engine_poisson_{profile}_load{load:g}",
                    "load_rps": load, "profile": profile,
                    "requests": n_requests, "slots": slots,
                    "n_pages": n_pages, "slo_ms": slo_ms,
-                   "shared_prefix": shared_prefix}
-            row.update(run_cell(engine, reqs, slo_ms))
+                   "shared_prefix": shared_prefix, "seed": seed}
+            row.update(run_cell(make_engine(), make_requests(), slo_ms))
+            if deadline_ms > 0:
+                # the admission-control A/B twin: identical seeded
+                # arrivals, DeadlinePolicy instead of strict FIFO —
+                # queue-expired requests shed with the retriable typed
+                # DeadlineExceeded instead of being served late
+                fifo_goodput = row.pop("deadline_goodput_tok_s")
+                twin = run_cell(make_engine(policy=DeadlinePolicy()),
+                                make_requests(), slo_ms)
+                row.update({
+                    "deadline_ms": deadline_ms,
+                    "fifo_goodput_tok_s": fifo_goodput,
+                    "shed_goodput_tok_s": twin["deadline_goodput_tok_s"],
+                    "reject_rate": twin["reject_rate"],
+                    "shed": twin["shed"],
+                    "p99_shed_ms": twin["p99_ms"],
+                })
             emit_row(row, out_path)
             rows.append(row)
     return rows
@@ -220,6 +287,17 @@ def main() -> None:
                         "model, models/decode.PAGE_BLOCK otherwise)")
     p.add_argument("--slo-ms", type=float, default=500.0,
                    help="per-token latency SLO for the goodput column")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="attach deadline = arrival + D ms to every "
+                        "request and run each cell twice — strict FIFO "
+                        "vs DeadlinePolicy shedding — emitting "
+                        "fifo_goodput_tok_s / shed_goodput_tok_s / "
+                        "reject_rate / p99_shed_ms (0 = off)")
+    p.add_argument("--chaos-smoke", action="store_true",
+                   help="run the servesan fault matrix "
+                        "(serving/chaos.py, forced-CPU subprocess) "
+                        "before the sweep; abort on any missed "
+                        "detection")
     p.add_argument("--shared-prefix", type=int, default=0,
                    help="prepend a common P-token system prompt to every "
                         "request — the prefix cache dedups its prefill "
@@ -236,6 +314,20 @@ def main() -> None:
                    help="append each completed cell as a JSON line")
     p.add_argument("--latex", default=None)
     args = p.parse_args()
+
+    if args.chaos_smoke:
+        # subprocess, not import: chaos.py forces JAX_PLATFORMS=cpu
+        # before jax initializes, which must not retarget THIS process
+        # (it may be a real-TPU sweep); the smoke is host-side either way
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env.pop("CS336_TPU_CHAOS", None)
+        rc = subprocess.run(
+            [sys.executable, "-m", "cs336_systems_tpu.serving.chaos",
+             "--seed", str(args.seed)], env=env).returncode
+        if rc != 0:
+            raise SystemExit(f"--chaos-smoke: servesan exit {rc} "
+                             f"(fault missed or build error)")
 
     if args.test_model:
         cfg = TransformerConfig(vocab_size=64, context_length=64,
@@ -267,7 +359,8 @@ def main() -> None:
                  args.prompt, args.new, args.slots, n_pages, max_blocks,
                  args.page_block, args.dp, args.seed, args.slo_ms,
                  args.out, shared_prefix=args.shared_prefix,
-                 prefix_cache=not args.no_prefix_cache)
+                 prefix_cache=not args.no_prefix_cache,
+                 deadline_ms=args.deadline_ms)
     print_table(results_table(rows, latex_path=args.latex))
 
 

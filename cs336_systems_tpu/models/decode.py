@@ -149,23 +149,30 @@ def validate_block_tables(tables, n_pages: int, read_only=None,
     mask — inactive rows write the scratch page, not their table, so
     they are exempt. Rows whose write position is past the table width
     (a finished row at its last block boundary) are skipped: the engine
-    evicts them before the next step dispatch."""
+    evicts them before the next step dispatch.
+
+    Violations raise ``serving.errors.CorruptBlockTable`` (a ValueError
+    subclass, imported lazily so this module stays import-light and
+    cycle-free) — non-retriable: one dispatch with such a table corrupts
+    other rows' live KV."""
     import numpy as np
+
+    from cs336_systems_tpu.serving.errors import CorruptBlockTable
 
     t = np.asarray(tables)
     if t.size == 0:
-        raise ValueError("block tables must be non-empty")
+        raise CorruptBlockTable("block tables must be non-empty")
     if t.min() < 0:
-        raise ValueError(
+        raise CorruptBlockTable(
             f"block table contains negative page id {int(t.min())}")
     if t.max() >= n_pages:
         where = np.argwhere(t == t.max())[0]
         if t.max() == n_pages:
-            raise ValueError(
+            raise CorruptBlockTable(
                 f"block table entry {tuple(int(i) for i in where)} is the "
                 f"reserved scratch page id {n_pages} — the scratch page "
                 "must never enter a block table (see init_paged_kv_cache)")
-        raise ValueError(
+        raise CorruptBlockTable(
             f"block table entry {tuple(int(i) for i in where)} = "
             f"{int(t.max())} out of range for a {n_pages}-page pool")
     if read_only is None or write_pos is None or block is None:
@@ -184,7 +191,7 @@ def validate_block_tables(tables, n_pages: int, read_only=None,
             continue  # finished row at its final boundary; evicted next
         page = int(t[i, wb])
         if page in ro:
-            raise ValueError(
+            raise CorruptBlockTable(
                 f"row {i} would WRITE shared (read-only) page {page} at "
                 f"block {wb} (pos {int(pos[i])}) — copy-on-write requires "
                 "the first partially-filled block to be private "

@@ -19,22 +19,57 @@ ISSUE 9 adds the prefix cache (prefix_cache.py): completed-prefill KV
 pages published into a hash-chain trie and shared COPY-ON-WRITE across
 requests via PagePool refcounts — N requests with a common system prompt
 pay its prefill and HBM once, host-side only, zero new collectives.
+
+ISSUE 10 adds the robustness layer: a typed ``errors.ServingError``
+failure surface with ``retriable`` verdicts, pluggable admission
+policies (``DeadlinePolicy`` sheds SLO-unreachable requests),
+``ServingEngine.cancel``/poisoned-slot containment/``self_check``, and
+the servesan chaos harness (chaos.py — ``python -m
+cs336_systems_tpu.serving.chaos``) that injects known faults and proves
+the detectors fire.
 """
 
 from cs336_systems_tpu.serving.engine import ServingEngine, make_engine_step
+from cs336_systems_tpu.serving.errors import (
+    AdmissionImpossible,
+    CorruptBlockTable,
+    DeadlineExceeded,
+    InvariantViolation,
+    PoolExhausted,
+    RefcountViolation,
+    ServingError,
+    SlotPoisoned,
+)
 from cs336_systems_tpu.serving.pool import PagePool
 from cs336_systems_tpu.serving.prefix_cache import (
     PrefixCache,
     params_fingerprint,
 )
-from cs336_systems_tpu.serving.scheduler import Request, Scheduler
+from cs336_systems_tpu.serving.scheduler import (
+    AdmissionPolicy,
+    DeadlinePolicy,
+    FifoPolicy,
+    Request,
+    Scheduler,
+)
 
 __all__ = [
+    "AdmissionImpossible",
+    "AdmissionPolicy",
+    "CorruptBlockTable",
+    "DeadlineExceeded",
+    "DeadlinePolicy",
+    "FifoPolicy",
+    "InvariantViolation",
     "PagePool",
+    "PoolExhausted",
     "PrefixCache",
+    "RefcountViolation",
     "Request",
     "Scheduler",
     "ServingEngine",
+    "ServingError",
+    "SlotPoisoned",
     "make_engine_step",
     "params_fingerprint",
 ]

@@ -1,0 +1,463 @@
+"""servesan — the serving-engine chaos harness (ISSUE 10).
+
+    python -m cs336_systems_tpu.serving.chaos --list
+    python -m cs336_systems_tpu.serving.chaos                 # all + clean
+    python -m cs336_systems_tpu.serving.chaos --fault leak-page --json
+    python -m cs336_systems_tpu.serving.chaos --mesh dp8 --seed 3
+
+The gradsan pattern (analysis/gradsan.py, PR 6) applied to the serving
+control plane: the invariant checkers
+(``ServingEngine.self_check`` → validate_block_tables +
+PagePool.check_conserved + PrefixCache.self_check + slot coherence +
+finite sampling state) exist to catch specific failure classes — so
+INJECT each failure class deliberately and prove the right typed
+``serving.errors`` exception fires. A detector that has never seen its
+fault is a comment, not a check.
+
+Each fault perturbs a REAL engine mid-trace: 8 requests sharing one
+full prefix block (exercising the shared/refcounted page regime) with
+distinct tails and varied ``max_new`` join, stream and evict over a
+virtual clock; after ``PRE_STEPS`` clean steps the named seam is
+corrupted and the harness keeps stepping, running ``self_check`` after
+every step, until a ``ServingError`` surfaces (from the sweep OR from
+the engine's own operation — double-free trips the allocator at
+eviction time, a corrupt table trips the pre-dispatch validation). The
+verdict compares the caught error's TYPE and message against the fault's
+expected signature. The clean run (no injection) must drain with zero
+findings and a fully-free pool — the false-positive gate.
+
+Everything is seeded and host-side: same seed → same trace, same
+detection step, on single-device, dp8 and dp2×tp4 alike (the jit step
+program is never touched — step-program invariance is pinned separately
+by the serve_engine lint families).
+
+Exit status: 0 every requested fault detected with the expected typed
+error (and the clean run clean), 1 a fault was MISSED / misclassified /
+the clean run raised, 2 the trace failed to build. Same gate semantics
+as gradsan — scripts/run_tests_and_package.sh wires it into CI as-is.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU backend BEFORE jax initializes (the site TPU
+# plugin must not grab the tunneled chip for a host-side control-plane
+# check) — same pattern as analysis/gradsan.py; CS336_TPU_CHAOS=1 opts
+# out. The 8-virtual-device flag makes --mesh dp8/dp2xtp4 work
+# standalone.
+if not os.environ.get("CS336_TPU_CHAOS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import re
+import sys
+import traceback
+
+import numpy as np
+
+from cs336_systems_tpu.serving.errors import (
+    CorruptBlockTable,
+    InvariantViolation,
+    RefcountViolation,
+    ServingError,
+    SlotPoisoned,
+)
+
+PRE_STEPS = 3    # clean decode steps before the injection
+MAX_STEPS = 64   # post-injection step bound (clean trace drains in ~10)
+
+
+class ChaosBuildError(RuntimeError):
+    """The trace could not be built/driven far enough to inject — exit 2
+    territory, distinct from a missed detection."""
+
+
+# -- the standard trace -------------------------------------------------
+
+
+def _geometry():
+    from cs336_systems_tpu.analysis.registry import serve_chaos_geometry
+
+    return serve_chaos_geometry()
+
+
+def _build_engine(mesh_name: str = "none", seed: int = 0):
+    """The standard chaos engine: registry geometry, tiny config, prefix
+    cache on, virtual clock (the harness passes explicit ``now``)."""
+    import jax
+
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.serving.engine import ServingEngine
+
+    slots, n_pages, max_blocks, blk = _geometry()
+    cfg = _tiny_cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
+    mesh = dp = tp = None
+    if mesh_name == "dp8":
+        mesh, dp = make_mesh({"dp": 8}), "dp"
+    elif mesh_name == "dp2xtp4":
+        mesh, dp, tp = make_mesh({"dp": 2, "tp": 4}), "dp", "tp"
+    elif mesh_name != "none":
+        raise ChaosBuildError(f"unknown mesh {mesh_name!r} "
+                              f"(none | dp8 | dp2xtp4)")
+    return ServingEngine(
+        params, cfg, key=jax.random.PRNGKey(seed + 1), slots=slots,
+        n_pages=n_pages, max_blocks=max_blocks, page_block=blk,
+        mesh=mesh, dp_axis=dp, tp_axis=tp)
+
+
+def _build_requests(seed: int):
+    """8 requests: one SHARED full prefix block + distinct 4-token tails
+    (so the shared/refcounted page regime is live on every shard that
+    admits more than one), ``max_new = 4 + (i % 4)`` so evictions are
+    staggered — early finishers free slots mid-trace while the
+    longest-lived request (the fault victim) still streams."""
+    from cs336_systems_tpu.serving.scheduler import Request
+
+    _, _, _, blk = _geometry()
+    rng = np.random.default_rng(seed)
+    vocab = 64  # registry _tiny_cfg vocab
+    prefix = rng.integers(0, vocab, size=blk)
+    reqs = []
+    for i in range(8):
+        tail = rng.integers(0, vocab, size=4)
+        prompt = np.concatenate([prefix, tail]).astype(np.int32)
+        reqs.append(Request(i, prompt, max_new_tokens=4 + (i % 4),
+                            arrival=0.0))
+    return reqs
+
+
+def _victim(eng):
+    """The running request with the MOST remaining tokens (ties: lowest
+    rid) — guaranteed to still be streaming when staggered early
+    finishers free slots, which is what duplicate-join and the
+    eviction-seam faults need."""
+    if not eng.running:
+        raise ChaosBuildError("no running request to pick a victim from")
+    slot = min(eng.running, key=lambda s: (
+        -(eng.running[s].max_new_tokens - len(eng.running[s].tokens)),
+        eng.running[s].rid))
+    return slot, eng.running[slot]
+
+
+# -- the fault injectors ------------------------------------------------
+
+
+def _inject_leak_page(eng):
+    """Drop the victim's private pages on the floor at eviction: its
+    owner record vanishes but the pages never return to the free list."""
+    slot, req = _victim(eng)
+    pool = eng.pools[slot // eng.slots_per]
+    orig = pool.free
+
+    def bad_free(owner, _orig=orig, _rid=req.rid, _pool=pool):
+        if owner == _rid:
+            _pool._owned.pop(owner)  # leak: no free-list extend
+            return 0
+        return _orig(owner)
+
+    pool.free = bad_free
+
+
+def _inject_double_free(eng):
+    """Free the victim's pages TWICE at eviction — the classic
+    use-after-free seam; the allocator itself must refuse the second."""
+    slot, req = _victim(eng)
+    pool = eng.pools[slot // eng.slots_per]
+    orig = pool.free
+
+    def bad_free(owner, _orig=orig, _rid=req.rid):
+        n = _orig(owner)
+        if owner == _rid:
+            _orig(owner)  # second free — must raise, not corrupt
+        return n
+
+    pool.free = bad_free
+
+
+def _inject_refcount_drift(eng):
+    """Bump a shared page's refcount with no table referencing it."""
+    for pool in eng.pools:
+        shared = pool.shared_page_ids()
+        if shared:
+            pool._ref[min(shared)] += 1
+            return
+    raise ChaosBuildError("no shared page in any pool to drift")
+
+
+def _inject_corrupt_table(eng):
+    """Point a live block-table entry at the reserved scratch page."""
+    slot, _req = _victim(eng)
+    eng.tables[slot, eng.max_blocks - 1] = eng.n_pages
+
+
+def _inject_stale_table(eng):
+    """Point a live block-table entry at a FREE page — in range, not
+    shared, but allocated to nobody (the dangling-reference seam)."""
+    slot, req = _victim(eng)
+    pool = eng.pools[slot // eng.slots_per]
+    if not pool._free:
+        raise ChaosBuildError("no free page to stale-point at")
+    eng.tables[slot, eng.max_blocks - 1] = pool._free[-1]
+
+
+def _inject_cow_violation(eng):
+    """Mark the victim's WRITE block page as shared — allocator state
+    kept conservation-consistent on purpose, so ONLY the copy-on-write
+    table check can catch the next dispatch stamping a shared page."""
+    slot, req = _victim(eng)
+    pool = eng.pools[slot // eng.slots_per]
+    wb = int(eng.pos[slot]) // eng.page_block
+    page = int(eng.tables[slot, wb])
+    held = pool._owned.get(req.rid, [])
+    if page not in held:
+        raise ChaosBuildError(
+            f"victim write page {page} is not private (trace drift?)")
+    held.remove(page)
+    if not held:
+        del pool._owned[req.rid]
+    pool._shared[("chaos-cow", page)] = [page]
+    pool._ref[page] = 1
+    pool._acquired.setdefault(req.rid, []).append(page)
+
+
+def _inject_nan_logits(eng):
+    """Poison the victim slot's carried logits with NaN."""
+    slot, _req = _victim(eng)
+    eng.logits[slot, : min(8, eng.logits.shape[1])] = np.nan
+
+
+def _inject_duplicate_join(eng):
+    """Queue a second request with a LIVE rid, bypassing submit()'s
+    duplicate guard (a buggy front-end retry): it must be caught at
+    admission (double alloc/acquire on the victim's shard) or by the
+    running-set uniqueness sweep when it lands on another shard."""
+    from cs336_systems_tpu.serving.scheduler import Request
+
+    _slot, req = _victim(eng)
+    dup = Request(req.rid, np.array(req.prompt), 2, arrival=0.0)
+    eng.scheduler._queue.insert(0, (0.0, -1, dup))
+
+
+def _inject_premature_evict(eng):
+    """Return the victim's private pages to the free list while its
+    slot still streams — the next join may be handed pages a live block
+    table points at."""
+    slot, req = _victim(eng)
+    eng.pools[slot // eng.slots_per].free(req.rid)
+
+
+# fault -> (injector, expected error classes, message pattern)
+FAULTS = {
+    "leak-page": (
+        _inject_leak_page, (InvariantViolation,), r"conserved"),
+    "double-free": (
+        _inject_double_free, (RefcountViolation,), r"double free"),
+    "refcount-drift": (
+        _inject_refcount_drift, (RefcountViolation,),
+        r"disagree with acquire records"),
+    "corrupt-table": (
+        _inject_corrupt_table, (CorruptBlockTable,), r"scratch"),
+    "stale-table": (
+        _inject_stale_table, (InvariantViolation,), r"not allocated"),
+    "cow-violation": (
+        _inject_cow_violation, (CorruptBlockTable,), r"read-only"),
+    "nan-logits": (
+        _inject_nan_logits, (SlotPoisoned,), r"non-finite"),
+    "duplicate-join": (
+        _inject_duplicate_join, (InvariantViolation, RefcountViolation),
+        r"duplicate|double"),
+    "premature-evict": (
+        _inject_premature_evict, (InvariantViolation,), r"not allocated"),
+}
+
+
+def fault_names():
+    return list(FAULTS)
+
+
+# -- the drive loop -----------------------------------------------------
+
+
+def _drive(eng, inject=None):
+    """Drive the standard trace: PRE_STEPS clean (self_check MUST stay
+    silent — a raise here is a build error, the trace itself is broken),
+    inject, then step + self_check until a ServingError surfaces or the
+    engine drains. Returns (error-or-None, steps_taken)."""
+    t = 0.0
+    for _ in range(PRE_STEPS):
+        eng.step(t)
+        t += 1.0
+        eng.self_check()  # pre-injection: any raise = ChaosBuildError
+    if inject is not None:
+        inject(eng)
+    steps = 0
+    try:
+        eng.self_check()
+        for _ in range(MAX_STEPS):
+            if not eng.running and not len(eng.scheduler):
+                break
+            eng.step(t)
+            t += 1.0
+            steps += 1
+            eng.self_check()
+        else:
+            raise ChaosBuildError(
+                f"trace did not drain within {MAX_STEPS} steps")
+        eng.check_idle()
+    except ServingError as e:
+        return e, steps
+    return None, steps
+
+
+def run_fault(name: str, mesh_name: str = "none", seed: int = 0) -> dict:
+    """Inject fault ``name`` into a fresh standard trace and report the
+    verdict: ``detected`` = a ServingError surfaced, ``ok`` = it was the
+    fault's EXPECTED type with the expected message signature."""
+    if name not in FAULTS:
+        raise ChaosBuildError(f"unknown fault {name!r} (see --list)")
+    inject, expected, pattern = FAULTS[name]
+    eng = _build_engine(mesh_name, seed)
+    for r in _build_requests(seed):
+        eng.submit(r)
+    try:
+        err, steps = _drive(eng, inject)
+    except ServingError:
+        raise  # cannot happen: _drive catches; defensive
+    detected = err is not None
+    ok = (detected and isinstance(err, expected)
+          and re.search(pattern, str(err)) is not None)
+    return {
+        "fault": name,
+        "mesh": mesh_name,
+        "seed": seed,
+        "expected": [c.__name__ for c in expected],
+        "pattern": pattern,
+        "detected": detected,
+        "ok": bool(ok),
+        "steps_after_injection": steps,
+        "error": None if err is None else {
+            "type": type(err).__name__,
+            "retriable": err.retriable,
+            "shard": err.shard,
+            "message": str(err),
+        },
+    }
+
+
+def run_clean(mesh_name: str = "none", seed: int = 0) -> dict:
+    """The false-positive gate: the un-injected trace must drain with
+    zero findings, every request completed, and every page free."""
+    eng = _build_engine(mesh_name, seed)
+    reqs = _build_requests(seed)
+    for r in reqs:
+        eng.submit(r)
+    err, steps = _drive(eng, None)
+    complete = set(eng.results) == {r.rid for r in reqs}
+    return {
+        "fault": "clean",
+        "mesh": mesh_name,
+        "seed": seed,
+        "detected": err is not None,
+        "ok": err is None and complete,
+        "all_requests_completed": complete,
+        "steps_after_injection": steps,
+        "error": None if err is None else {
+            "type": type(err).__name__,
+            "retriable": err.retriable,
+            "shard": err.shard,
+            "message": str(err),
+        },
+    }
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def _fmt_report(rows: list[dict]) -> str:
+    lines = [
+        f"servesan: chaos harness over the standard multi-join/evict "
+        f"trace (mesh={rows[0]['mesh']}, seed={rows[0]['seed']})",
+        f"  {'fault':<16} {'expected':<36} {'caught':<20} verdict",
+    ]
+    for r in rows:
+        if r["fault"] == "clean":
+            caught = ("-" if r["error"] is None
+                      else r["error"]["type"])
+            verdict = ("clean" if r["ok"]
+                       else "FALSE POSITIVE" if r["detected"]
+                       else "INCOMPLETE DRAIN")
+            lines.append(f"  {'clean':<16} {'(zero findings)':<36} "
+                         f"{caught:<20} {verdict}")
+            continue
+        caught = "-" if r["error"] is None else r["error"]["type"]
+        verdict = ("detected" if r["ok"]
+                   else "MISSED" if not r["detected"]
+                   else "WRONG ERROR")
+        lines.append(f"  {r['fault']:<16} {'|'.join(r['expected']):<36} "
+                     f"{caught:<20} {verdict}")
+    n_bad = sum(1 for r in rows if not r["ok"])
+    lines.append("  all detected, clean run clean" if n_bad == 0
+                 else f"  {n_bad} verdict(s) FAILED")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="servesan",
+        description="serving-engine chaos harness: inject known faults "
+                    "and prove the typed invariant sweep detects them")
+    ap.add_argument("--fault", help="single fault to inject (see --list); "
+                                    "default: every fault + the clean run")
+    ap.add_argument("--mesh", default="none",
+                    choices=("none", "dp8", "dp2xtp4"),
+                    help="mesh to run the engine on (default none = "
+                         "single device)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trace seed (params, prompts, PRNG chains)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list fault classes, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        if args.json:
+            print(json.dumps({"faults": fault_names()}))
+        else:
+            print("fault classes (--fault):")
+            for name in fault_names():
+                print(f"  {name}")
+        return 0
+
+    try:
+        if args.fault:
+            rows = [run_fault(args.fault, args.mesh, args.seed)]
+        else:
+            rows = [run_fault(name, args.mesh, args.seed)
+                    for name in fault_names()]
+            rows.append(run_clean(args.mesh, args.seed))
+    except Exception as e:  # noqa: BLE001 — exit 2 is the build-error gate
+        if args.json:
+            print(json.dumps({"schema": "servesan/v1",
+                              "error": f"{type(e).__name__}: {e}"}))
+        else:
+            traceback.print_exc()
+            print(f"servesan: BUILD/RUN ERROR: {type(e).__name__}: {e}")
+        return 2
+
+    print(json.dumps({"schema": "servesan/v1", "rows": rows})
+          if args.json else _fmt_report(rows))
+    return 0 if all(r["ok"] for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,19 @@ was built for):
   host-side allocator work — the step program and its collective
   contract are byte-identical with the cache on or off.
 
+- ROBUSTNESS (ISSUE 10): every failure is a typed ``serving.errors``
+  exception with a ``retriable`` verdict; admission is policy-pluggable
+  (``scheduler.DeadlinePolicy`` sheds SLO-unreachable requests with a
+  retriable ``DeadlineExceeded``); ``cancel(rid)`` evicts a queued or
+  mid-stream request through the same host-table rewrite path as EOS;
+  a slot whose carried logits go non-finite is contained pre-dispatch
+  (``SlotPoisoned``) instead of streaming garbage; and ``self_check()``
+  is the consolidated invariant sweep the servesan chaos harness
+  (serving/chaos.py) proves detects every injected fault class. All of
+  it is host-side control plane — the jit step program stays
+  byte-identical (the serve_engine/serve_engine_prefix lint contracts
+  hold verbatim, zero new collectives).
+
 TPU perf notes (CPU-correct here; open items for the chip, queued in
 results/decode_v5e.txt): per-slot host state is re-uploaded every step
 (~KBs; should become device-resident carries), and the step program
@@ -80,9 +93,15 @@ from cs336_systems_tpu.models.decode import (
 from cs336_systems_tpu.models.transformer import TransformerConfig
 from cs336_systems_tpu.parallel.serve import engine_specs
 from cs336_systems_tpu.parallel.serve import lint_contract as _serve_lint
+from cs336_systems_tpu.serving.errors import (
+    AdmissionImpossible,
+    InvariantViolation,
+    ServingError,
+    SlotPoisoned,
+)
 from cs336_systems_tpu.serving.pool import PagePool
 from cs336_systems_tpu.serving.prefix_cache import PrefixCache, params_fingerprint
-from cs336_systems_tpu.serving.scheduler import Request, Scheduler
+from cs336_systems_tpu.serving.scheduler import AdmissionPolicy, Request, Scheduler
 
 
 def engine_lint_contract(cfg: TransformerConfig, dp_axis=None, tp_axis=None,
@@ -178,11 +197,18 @@ class ServingEngine:
                  attn_impl: str = "auto", approx_top_k: bool = False,
                  mesh=None, dp_axis: str | None = None,
                  tp_axis: str | None = None,
-                 clock=None, on_token=None, prefix_cache: bool = True):
+                 clock=None, on_token=None, prefix_cache: bool = True,
+                 policy: AdmissionPolicy | None = None):
         if page_block <= 0 or page_block % 8:
             raise ValueError(
                 f"page block must be a positive multiple of 8, "
                 f"got {page_block}")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if n_pages < 1 or max_blocks < 1:
+            raise ValueError(
+                f"n_pages ({n_pages}) and max_blocks ({max_blocks}) "
+                f"must be >= 1")
         dp = 1
         if mesh is not None:
             for name, ax in (("dp_axis", dp_axis), ("tp_axis", tp_axis)):
@@ -227,9 +253,15 @@ class ServingEngine:
         self.prefix_prompt_tokens = 0  # prompt tokens admitted
         self.prefill_tokens = 0        # tokens actually run through prefill
         self.shared_kv_bytes_peak = 0  # high-water of shared-page HBM
-        self.scheduler = Scheduler()
+        self.scheduler = Scheduler(policy)
         self.running: dict[int, Request] = {}
         self.results: dict[int, np.ndarray] = {}
+        # terminal non-success outcomes (ISSUE 10): rid -> retriable
+        # typed error for shed/poisoned requests; rid -> partial stream
+        # for cancelled ones. results/failed/cancelled are disjoint and
+        # together cover every submitted rid once the engine drains.
+        self.failed: dict[int, ServingError] = {}
+        self.cancelled: dict[int, np.ndarray] = {}
         self.steps = 0
 
         # host-side slot state, re-uploaded per step (see module note)
@@ -264,20 +296,32 @@ class ServingEngine:
         return -(-(req.prompt.size + req.max_new_tokens) // self.page_block)
 
     def submit(self, req: Request) -> None:
+        """Queue a request, or raise the non-retriable
+        ``AdmissionImpossible`` when NO sequence of evictions could ever
+        admit it — checked exhaustively at submit time (context window,
+        whole-shard page pool, block-table width, live rid) so an
+        impossible request never occupies queue space it cannot convert
+        into a slot, and a page-starved scheduler head can only ever be
+        waiting on pages that CAN free up."""
         if req.prompt.size + req.max_new_tokens > self.cfg.context_length:
-            raise ValueError(
+            raise AdmissionImpossible(
                 f"request {req.rid}: prompt ({req.prompt.size}) + "
                 f"max_new_tokens ({req.max_new_tokens}) exceeds "
                 f"context_length={self.cfg.context_length}")
         npg = self._pages_needed(req)
         if npg > self.n_pages:
-            raise ValueError(
+            raise AdmissionImpossible(
                 f"request {req.rid} needs {npg} pages; the shard pool has "
                 f"{self.n_pages} — it could never be admitted")
         if npg > self.max_blocks:
-            raise ValueError(
+            raise AdmissionImpossible(
                 f"request {req.rid} needs {npg} blocks; tables are "
                 f"{self.max_blocks} wide")
+        if (req.rid in self.scheduler
+                or any(r.rid == req.rid for r in self.running.values())):
+            raise AdmissionImpossible(
+                f"request {req.rid} is already queued or running "
+                f"(duplicate rid)")
         self.scheduler.submit(req)
 
     def _admit(self, now: float) -> int:
@@ -299,6 +343,11 @@ class ServingEngine:
         FLUSHED first (prefill + publish) and admission continues — an
         arrival burst sharing a cold prefix prefills it once, not N
         times."""
+        # policy shedding first: an expired request must never reach a
+        # slot (FIFO's policy sheds nothing — identical behavior)
+        for req, err in self.scheduler.shed_expired(now):
+            req.finish_time = now
+            self.failed[req.rid] = err
         admitted = 0
         joins = []
         # chain hashes the current join batch will publish, per shard
@@ -322,7 +371,7 @@ class ServingEngine:
                         break
                 if slot is None:
                     break
-                self.scheduler.pop()
+                self.scheduler.pop(req.rid)
                 pages = self.pools[slot // self.slots_per].alloc(
                     npg, req.rid)
                 self.running[slot] = req
@@ -359,7 +408,7 @@ class ServingEngine:
                 break
             neg_hit, slot, shard, hit_pages, cached_logits = best
             hit = -neg_hit
-            self.scheduler.pop()
+            self.scheduler.pop(req.rid)
             pool, cache = self.pools[shard], self.prefix_caches[shard]
             if hit:
                 pool.acquire(hit_pages, req.rid)
@@ -591,7 +640,12 @@ class ServingEngine:
 
     # -- the steady-state step ---------------------------------------
 
-    def _finish(self, slot: int, req: Request, when: float) -> None:
+    def _release_slot(self, slot: int, req: Request, when: float) -> None:
+        """The one eviction path (EOS, max_new, cancel, poison): free
+        the request's private pages, release its shared prefix refs
+        (pages stay cached at refcount-1 less), deactivate the slot —
+        the step program then scratch-steers its writes — and drop it
+        from running. Host-table rewrites only; zero recompiles."""
         pool = self.pools[slot // self.slots_per]
         if pool.owns(req.rid):
             pool.free(req.rid)
@@ -600,7 +654,61 @@ class ServingEngine:
         self.active[slot] = 0
         del self.running[slot]
         req.finish_time = when
+
+    def _finish(self, slot: int, req: Request, when: float) -> None:
+        self._release_slot(slot, req, when)
         self.results[req.rid] = np.asarray(req.tokens, np.int32)
+
+    def _fail_slot(self, slot: int, req: Request, err: ServingError,
+                   when: float) -> None:
+        """Evict a slot with a typed error instead of a result; the
+        tokens streamed before the failure stay on ``req.tokens``."""
+        self._release_slot(slot, req, when)
+        self.failed[req.rid] = err
+
+    def cancel(self, rid: int, now: float | None = None) -> bool:
+        """Cancel a request mid-stream or while queued; returns whether
+        anything was cancelled (False: unknown/already finished — cancel
+        is idempotent). A running request's eviction is the same
+        host-table rewrite as EOS (pages freed, prefix refs released,
+        slot scratch-steered; zero recompiles); its partial stream lands
+        in ``cancelled[rid]``. Remaining streams are untouched — tokens
+        are row-local, so they stay bit-identical to an oracle that
+        never saw the cancelled request."""
+        when = now
+        if when is None:
+            when = self.clock() if self.clock is not None else math.inf
+        req = self.scheduler.remove(rid)
+        if req is not None:
+            req.finish_time = when
+            self.cancelled[rid] = np.asarray(req.tokens, np.int32)
+            return True
+        for slot, run in list(self.running.items()):
+            if run.rid == rid:
+                self._release_slot(slot, run, when)
+                self.cancelled[rid] = np.asarray(run.tokens, np.int32)
+                return True
+        return False
+
+    def _contain_poisoned(self, when: float) -> list:
+        """Poisoned-slot containment: a slot whose CARRIED logits went
+        non-finite would sample garbage on the next dispatch — evict it
+        with the retriable ``SlotPoisoned`` first (tokens already
+        streamed came from finite logits and stay valid). Runs before
+        every dispatch, so prefill-poisoned joins are contained before
+        their first decode step too. Returns [(rid, err)]."""
+        out = []
+        for slot in sorted(self.running):
+            if np.isfinite(self.logits[slot]).all():
+                continue
+            req = self.running[slot]
+            err = SlotPoisoned(
+                f"slot {slot} (rid {req.rid}): non-finite carried "
+                f"logits after {len(req.tokens)} tokens",
+                shard=slot // self.slots_per)
+            self._fail_slot(slot, req, err, when)
+            out.append((req.rid, err))
+        return out
 
     def step(self, now: float | None = None) -> list:
         """Admit what has arrived by ``now``, run ONE decode step over
@@ -609,6 +717,9 @@ class ServingEngine:
         if now is None:
             now = self.clock() if self.clock is not None else math.inf
         self._admit(now)
+        # containment BEFORE dispatch: a poisoned carry never reaches
+        # the sampler (joins above may have admitted poisoned prefills)
+        self._contain_poisoned(now)
         if not self.running:
             return []
         # copy-on-write, re-checked per dispatch: the step is about to
@@ -672,26 +783,91 @@ class ServingEngine:
     def check_conserved(self) -> None:
         """Shard-by-shard pool partition + refcount check against the
         LIVE block tables (serving/pool.check_conserved) — runnable at
-        any point, drained or not."""
+        any point, drained or not. Re-raises the pool's typed error
+        with the shard attached."""
         for k in range(self.dp):
             tabs = [self.tables[s] for s in sorted(self.running)
                     if s // self.slots_per == k]
             try:
                 self.pools[k].check_conserved(tabs)
-            except AssertionError as e:
-                raise AssertionError(f"shard {k}: {e}") from None
+            except ServingError as e:
+                raise type(e)(e.detail, shard=k) from None
 
     def check_idle(self) -> None:
         """Drained-engine invariant (the CI smoke's leak gate): no
         running requests and every shard pool fully free — the prefix
         caches spill their (necessarily unreferenced) pages first."""
         if self.running:
-            raise AssertionError(f"requests still running: "
-                                 f"{sorted(r.rid for r in self.running.values())}")
+            raise InvariantViolation(
+                f"requests still running: "
+                f"{sorted(r.rid for r in self.running.values())}")
         for k, p in enumerate(self.pools):
             if self.prefix_caches is not None:
                 self.prefix_caches[k].drop_unreferenced()
             try:
                 p.check_all_free()
-            except AssertionError as e:
-                raise AssertionError(f"shard {k}: {e}") from None
+            except ServingError as e:
+                raise type(e)(e.detail, shard=k) from None
+
+    def self_check(self) -> None:
+        """Consolidated invariant sweep (ISSUE 10) — the detector the
+        servesan chaos harness (serving/chaos.py) proves catches every
+        injected fault class. Sweep order, most-specific error first:
+
+        1. block-table contracts (scratch-page + copy-on-write) →
+           ``CorruptBlockTable``
+        2. pool conservation partition → ``InvariantViolation``;
+           refcount vs acquire records / live tables →
+           ``RefcountViolation``
+        3. prefix-trie ↔ pool consistency → ``InvariantViolation``
+        4. slot ↔ allocator coherence: active mask == running set,
+           every running slot's table pages allocated TO that rid,
+           every private owner a running rid → ``InvariantViolation``
+        5. finite carried sampling state → ``SlotPoisoned``
+
+        Raises the first violation; a clean engine returns None. Pure
+        host-side reads — never dispatches, safe at any point."""
+        self._validate_tables()
+        self.check_conserved()
+        if self.prefix_caches is not None:
+            for k, cache in enumerate(self.prefix_caches):
+                cache.self_check(shard=k)
+        all_rids = [req.rid for req in self.running.values()]
+        running_rids = set(all_rids)
+        if len(all_rids) != len(running_rids):
+            dupes = sorted(r for r in running_rids
+                           if all_rids.count(r) > 1)
+            raise InvariantViolation(
+                f"duplicate rid(s) {dupes} in the running set — two "
+                f"slots are streaming the same request")
+        for slot in range(self.slots):
+            is_running = slot in self.running
+            if bool(self.active[slot]) != is_running:
+                raise InvariantViolation(
+                    f"slot {slot}: active={int(self.active[slot])} but "
+                    f"{'in' if is_running else 'not in'} the running set",
+                    shard=slot // self.slots_per)
+        for slot in sorted(self.running):
+            req = self.running[slot]
+            k = slot // self.slots_per
+            pool = self.pools[k]
+            allowed = set(pool.owned_by(req.rid) if pool.owns(req.rid)
+                          else []) | set(pool.acquired_by(req.rid))
+            table_pages = set(int(p) for p in self.tables[slot])
+            stray = table_pages - allowed
+            if stray:
+                raise InvariantViolation(
+                    f"slot {slot} (rid {req.rid}): table pages "
+                    f"{sorted(stray)} are not allocated to it", shard=k)
+        for k, pool in enumerate(self.pools):
+            orphans = pool.owners() - running_rids
+            if orphans:
+                raise InvariantViolation(
+                    f"private pages owned by non-running rids "
+                    f"{sorted(orphans, key=repr)}", shard=k)
+        for slot in sorted(self.running):
+            if not np.isfinite(self.logits[slot]).all():
+                req = self.running[slot]
+                raise SlotPoisoned(
+                    f"slot {slot} (rid {req.rid}): non-finite carried "
+                    f"logits", shard=slot // self.slots_per)

@@ -1,0 +1,116 @@
+"""Typed failure surface of the serving engine (ISSUE 10).
+
+Before this module every error path in ``serving/`` was a bare
+``assert``/``ValueError``/``KeyError``: a caller (the benchmark driver,
+a future RPC front-end, the chaos harness) could not tell "the pool is
+momentarily full, re-queue and retry" from "a refcount diverged from the
+live block tables — the engine's allocator state is corrupt, drain and
+rebuild". Every class here carries ``retriable``:
+
+- ``retriable=True``  — the ENGINE is healthy; the request failed for a
+  capacity/deadline reason and resubmitting later is safe and may
+  succeed (``PoolExhausted``, ``DeadlineExceeded``, ``SlotPoisoned``).
+- ``retriable=False`` — either the request can NEVER be served by this
+  engine (``AdmissionImpossible``) or an internal invariant broke and
+  the engine's state can no longer be trusted (``RefcountViolation``,
+  ``CorruptBlockTable``, ``InvariantViolation``): stop admitting, drain,
+  rebuild the pool.
+
+``shard``: the dp shard whose allocator/tables the violation was
+detected on (page ids, refcounts and prefix tries are shard-local —
+parallel/serve.engine_specs), ``None`` when not shard-attributable.
+
+Compatibility: each class also subclasses the builtin its call sites
+raised before (``PoolExhausted`` is a ``MemoryError``, the table/COW and
+refcount misuse errors are ``ValueError``s, the invariant-sweep errors
+are ``AssertionError``s), so pre-existing ``except``/``pytest.raises``
+sites keep working while new callers catch ``ServingError`` and branch
+on ``retriable``.
+
+This module imports nothing from the package (models/decode.py raises
+``CorruptBlockTable`` via a lazy import, and a one-way import keeps that
+cycle-free).
+"""
+
+from __future__ import annotations
+
+
+class ServingError(Exception):
+    """Base of the serving failure surface. ``retriable`` is a CLASS
+    property of the failure kind, not an instance judgement — see the
+    module docstring for the contract."""
+
+    retriable: bool = False
+
+    def __init__(self, detail: str = "", shard: int | None = None):
+        self.detail = detail
+        self.shard = shard
+        super().__init__(
+            detail if shard is None else f"shard {shard}: {detail}")
+
+
+class PoolExhausted(ServingError, MemoryError):
+    """The shard's free list cannot satisfy an allocation right now.
+    All-or-nothing: nothing was taken. Retriable — an eviction frees
+    pages and the same request fits later (strict-FIFO admission queues
+    it rather than raising; this surfaces only on direct pool use)."""
+
+    retriable = True
+
+
+class AdmissionImpossible(ServingError, ValueError):
+    """The request can NEVER be admitted by this engine — it exceeds
+    the context length, the whole per-shard page pool, the block-table
+    width, or reuses a live rid. Raised at ``submit`` time so the
+    request never occupies queue space it cannot convert into a slot.
+    Not retriable against this engine configuration."""
+
+    retriable = False
+
+
+class RefcountViolation(ServingError, ValueError):
+    """Shared/private page accounting was misused or has drifted:
+    double alloc/free, double acquire, early release, spilling a
+    referenced page, or a refcount that disagrees with the acquire
+    records / live block tables. The allocator state is no longer
+    trustworthy — not retriable."""
+
+    retriable = False
+
+
+class CorruptBlockTable(ServingError, ValueError):
+    """A block table names a page it must not: the reserved scratch
+    page, an out-of-range id, or (copy-on-write) a SHARED page in an
+    active row's write block. One dispatch with such a table corrupts
+    other requests' live KV — not retriable."""
+
+    retriable = False
+
+
+class DeadlineExceeded(ServingError):
+    """The request's queue wait already makes its deadline unreachable;
+    the deadline-aware admission policy sheds it instead of letting it
+    occupy a slot it can no longer use. Retriable: resubmit with a
+    fresh deadline when load drops."""
+
+    retriable = True
+
+
+class SlotPoisoned(ServingError):
+    """A slot's carried sampling state went non-finite; the engine
+    evicted it before streaming garbage tokens. The engine itself is
+    healthy (the slot was contained and its pages freed), so a
+    resubmission is SAFE — though a bit-identical retry of the same
+    (row, prompt) will reproduce deterministic poison."""
+
+    retriable = True
+
+
+class InvariantViolation(ServingError, AssertionError):
+    """A consolidated-sweep invariant failed: the pool partition leaked
+    or duplicated a page, a slot's table references pages not allocated
+    to it, the prefix trie lost consistency with the pool, or the
+    active mask disagrees with the running set. Engine state is corrupt
+    — drain and rebuild. ``shard``/``detail`` say where and what."""
+
+    retriable = False

@@ -23,6 +23,14 @@ Two ownership regimes (ISSUE 9 added the second):
   models/decode.validate_block_tables's read-only set), so sharing is
   pure aliasing: N tables, one physical page.
 
+Failure surface (ISSUE 10, serving/errors.py): capacity misses raise
+the retriable ``PoolExhausted`` (all-or-nothing — nothing was taken);
+ownership/refcount misuse (double alloc/free/acquire, early release,
+spilling a referenced page) raises the non-retriable
+``RefcountViolation``; the invariant sweeps raise ``InvariantViolation``
+for partition breaks and ``RefcountViolation`` for refcount drift, so a
+caller can tell "retry later" from "allocator state is corrupt".
+
 ``check_conserved()`` asserts the free list + private owners + shared
 allocations exactly partition the page range — each shared page counted
 ONCE — and that every shared page's refcount equals the number of
@@ -33,6 +41,12 @@ draining (ISSUE 8 + ISSUE 9 acceptance criteria).
 """
 
 from __future__ import annotations
+
+from cs336_systems_tpu.serving.errors import (
+    InvariantViolation,
+    PoolExhausted,
+    RefcountViolation,
+)
 
 
 class PagePool:
@@ -70,6 +84,13 @@ class PagePool:
         """True when ``owner`` holds a private allocation."""
         return owner in self._owned
 
+    def owners(self) -> set:
+        """All owners currently holding PRIVATE allocations — the
+        engine's self_check cross-references these against the running
+        set (an owner that is not a live request is an orphaned
+        allocation)."""
+        return set(self._owned)
+
     def acquired_by(self, owner) -> list[int]:
         """The owner's acquired SHARED pages, in acquire order (a copy);
         empty list for an owner with no acquire record."""
@@ -80,22 +101,34 @@ class PagePool:
         models/decode.validate_block_tables enforces copy-on-write with."""
         return set(self._ref)
 
+    def shared_alloc(self, tag) -> list[int] | None:
+        """The pages of shared allocation ``tag`` (a copy), or None —
+        the prefix trie's self_check cross-references its nodes here."""
+        pages = self._shared.get(tag)
+        return None if pages is None else list(pages)
+
+    def shared_tags(self) -> set:
+        """All live shared-allocation tags (the trie's node keys)."""
+        return set(self._shared)
+
     def refcount(self, page: int) -> int:
         """Block-table references on a SHARED page (KeyError: not shared)."""
         return self._ref[page]
 
     def alloc(self, n: int, owner) -> list[int]:
         """Take ``n`` PRIVATE pages for ``owner``; returns them in block
-        order. All-or-nothing: raises without touching the free list when
-        the pool cannot satisfy the request (the scheduler then leaves the
-        request queued until an eviction frees enough pages)."""
+        order. All-or-nothing: raises ``PoolExhausted`` without touching
+        the free list when the pool cannot satisfy the request (the
+        scheduler then leaves the request queued until an eviction frees
+        enough pages)."""
         if n < 1:
             raise ValueError(f"alloc needs n >= 1, got {n}")
         if owner in self._owned:
-            raise ValueError(f"owner {owner!r} already holds pages "
-                             f"{self._owned[owner]} (double alloc)")
+            raise RefcountViolation(
+                f"owner {owner!r} already holds pages "
+                f"{self._owned[owner]} (double alloc)")
         if n > len(self._free):
-            raise MemoryError(
+            raise PoolExhausted(
                 f"pool exhausted: {n} pages requested, "
                 f"{len(self._free)} free of {self.n_pages}")
         pages = [self._free.pop() for _ in range(n)]
@@ -105,9 +138,11 @@ class PagePool:
 
     def free(self, owner) -> int:
         """Return ALL of ``owner``'s private pages to the free list;
-        returns the count. Raises on unknown owner (double free)."""
+        returns the count. ``RefcountViolation`` on an unknown owner
+        (double free)."""
         if owner not in self._owned:
-            raise KeyError(f"owner {owner!r} holds no pages (double free?)")
+            raise RefcountViolation(
+                f"owner {owner!r} holds no pages (double free?)")
         pages = self._owned.pop(owner)
         self._free.extend(pages)
         return len(pages)
@@ -121,10 +156,11 @@ class PagePool:
         if n < 1:
             raise ValueError(f"alloc_shared needs n >= 1, got {n}")
         if tag in self._shared:
-            raise ValueError(f"shared tag {tag!r} already holds pages "
-                             f"{self._shared[tag]} (double alloc_shared)")
+            raise RefcountViolation(
+                f"shared tag {tag!r} already holds pages "
+                f"{self._shared[tag]} (double alloc_shared)")
         if n > len(self._free):
-            raise MemoryError(
+            raise PoolExhausted(
                 f"pool exhausted: {n} shared pages requested, "
                 f"{len(self._free)} free of {self.n_pages}")
         pages = [self._free.pop() for _ in range(n)]
@@ -140,13 +176,14 @@ class PagePool:
         cache pages, and the publisher's block table keeps its reference
         (recorded as an acquire, released at its eviction)."""
         if tag in self._shared:
-            raise ValueError(f"shared tag {tag!r} already exists")
+            raise RefcountViolation(f"shared tag {tag!r} already exists")
         if owner not in self._owned:
-            raise KeyError(f"owner {owner!r} holds no private pages")
+            raise RefcountViolation(
+                f"owner {owner!r} holds no private pages")
         held = self._owned[owner]
         for p in pages:
             if p not in held:
-                raise ValueError(
+                raise RefcountViolation(
                     f"page {p} is not in owner {owner!r}'s private "
                     f"allocation {held} — cannot promote")
         remaining = [p for p in held if p not in pages]
@@ -161,18 +198,18 @@ class PagePool:
 
     def acquire(self, pages: list[int], owner) -> None:
         """Bump the refcount of each SHARED page for a block table that
-        now references it. Raises on a page that is not shared (acquiring
-        a free/private page would alias mutable state) and on the same
-        owner acquiring the same page twice (its table would have to
-        contain the page twice)."""
+        now references it. ``RefcountViolation`` on a page that is not
+        shared (acquiring a free/private page would alias mutable state)
+        and on the same owner acquiring the same page twice (its table
+        would have to contain the page twice)."""
         mine = self._acquired.get(owner, [])
         for p in pages:
             if p not in self._ref:
-                raise ValueError(
+                raise RefcountViolation(
                     f"page {p} is not a shared page (acquire of "
                     f"free/private page)")
             if p in mine:
-                raise ValueError(
+                raise RefcountViolation(
                     f"owner {owner!r} already acquired shared page {p} "
                     f"(double acquire)")
         for p in pages:
@@ -182,15 +219,17 @@ class PagePool:
     def release(self, owner) -> int:
         """Drop ALL of ``owner``'s shared-page references (eviction);
         returns the count. Pages stay cached at refcount 0 until the
-        prefix cache spills them. Raises on an owner with no acquire
-        record (early/double release)."""
+        prefix cache spills them. ``RefcountViolation`` on an owner with
+        no acquire record (early/double release)."""
         if owner not in self._acquired:
-            raise KeyError(
+            raise RefcountViolation(
                 f"owner {owner!r} holds no shared references "
                 f"(double release?)")
         pages = self._acquired.pop(owner)
         for p in pages:
-            assert self._ref[p] > 0, f"refcount underflow on page {p}"
+            if self._ref[p] <= 0:
+                raise RefcountViolation(
+                    f"refcount underflow on page {p}")
             self._ref[p] -= 1
         return len(pages)
 
@@ -199,11 +238,11 @@ class PagePool:
         spill). Legal ONLY when every page's refcount is 0 — spilling a
         referenced page would free memory a live block table points at."""
         if tag not in self._shared:
-            raise KeyError(f"unknown shared tag {tag!r}")
+            raise RefcountViolation(f"unknown shared tag {tag!r}")
         pages = self._shared[tag]
         for p in pages:
             if self._ref[p]:
-                raise ValueError(
+                raise RefcountViolation(
                     f"shared page {p} (tag {tag!r}) still has "
                     f"refcount {self._ref[p]} — cannot spill")
         del self._shared[tag]
@@ -217,24 +256,25 @@ class PagePool:
     def check_conserved(self, block_tables=None) -> None:
         """Assert the free list, the private owners and the shared
         allocations exactly partition [0, n_pages) — each shared page
-        counted ONCE — no leak, no duplication, no scratch intrusion;
-        and that each shared page's refcount equals its acquire-record
-        count. ``block_tables``: optional iterable of the ACTIVE
-        requests' page-id lists — when given, each shared page's
-        refcount must also equal the number of tables containing it
-        (the refcount == owning-block-tables contract)."""
+        counted ONCE — no leak, no duplication, no scratch intrusion
+        (``InvariantViolation``); and that each shared page's refcount
+        equals its acquire-record count (``RefcountViolation`` — the
+        drifted-refcount signature). ``block_tables``: optional iterable
+        of the ACTIVE requests' page-id lists — when given, each shared
+        page's refcount must also equal the number of tables containing
+        it (the refcount == owning-block-tables contract)."""
         seen = list(self._free)
         for pages in self._owned.values():
             seen.extend(pages)
         for pages in self._shared.values():
             seen.extend(pages)
         if len(seen) != len(set(seen)):
-            raise AssertionError("page id duplicated across free/owned/"
-                                 "shared sets")
+            raise InvariantViolation("page id duplicated across free/owned/"
+                                     "shared sets")
         if set(seen) != set(range(self.n_pages)):
             missing = set(range(self.n_pages)) - set(seen)
             extra = set(seen) - set(range(self.n_pages))
-            raise AssertionError(
+            raise InvariantViolation(
                 f"pool not conserved: leaked={sorted(missing)} "
                 f"foreign={sorted(extra)}")
         counts: dict[int, int] = {}
@@ -242,7 +282,7 @@ class PagePool:
             for p in pages:
                 counts[p] = counts.get(p, 0) + 1
         if counts != {p: r for p, r in self._ref.items() if r}:
-            raise AssertionError(
+            raise RefcountViolation(
                 f"shared refcounts {self._ref} disagree with acquire "
                 f"records {counts}")
         if block_tables is not None:
@@ -253,7 +293,7 @@ class PagePool:
                         table_counts[p] = table_counts.get(p, 0) + 1
             for p, r in self._ref.items():
                 if table_counts.get(p, 0) != r:
-                    raise AssertionError(
+                    raise RefcountViolation(
                         f"shared page {p}: refcount {r} but "
                         f"{table_counts.get(p, 0)} block tables contain it")
 
@@ -263,11 +303,11 @@ class PagePool:
         gate."""
         self.check_conserved()
         if self._owned:
-            raise AssertionError(
+            raise InvariantViolation(
                 f"pages still owned after drain: "
                 f"{ {k: v for k, v in self._owned.items()} }")
         if self._shared:
-            raise AssertionError(
+            raise InvariantViolation(
                 f"shared pages still cached after drain: "
                 f"{ {k: v for k, v in self._shared.items()} } — spill the "
                 "prefix cache before the all-free check")

@@ -50,6 +50,8 @@ import hashlib
 
 import numpy as np
 
+from cs336_systems_tpu.serving.errors import InvariantViolation
+
 
 def params_fingerprint(params) -> bytes:
     """Cheap content-sensitive digest of a param pytree: tree structure,
@@ -229,3 +231,44 @@ class PrefixCache:
     def shared_pages(self) -> int:
         """Number of pages currently held by the cache."""
         return len(self._nodes)
+
+    def self_check(self, shard: int | None = None) -> None:
+        """Trie ↔ pool consistency sweep (ISSUE 10, part of the engine's
+        consolidated ``self_check``): every trie node must name a live
+        shared allocation holding exactly its page, every pool shared
+        tag must be a trie node (no orphan shared allocations), and the
+        parent links must form a well-rooted chain (parent present,
+        depth exactly one less). Raises ``InvariantViolation`` — a break
+        here means spill/publish state diverged from the allocator and
+        neither side can be trusted."""
+        for h, node in self._nodes.items():
+            pages = self.pool.shared_alloc(h)
+            if pages is None:
+                raise InvariantViolation(
+                    f"trie node at depth {node.depth} has no shared "
+                    f"allocation in the pool", shard=shard)
+            if pages != [node.page]:
+                raise InvariantViolation(
+                    f"trie node at depth {node.depth} maps to page "
+                    f"{node.page} but the pool holds {pages} under its "
+                    f"tag", shard=shard)
+            if node.parent is None:
+                if node.depth != 0:
+                    raise InvariantViolation(
+                        f"root-linked trie node has depth {node.depth}",
+                        shard=shard)
+            else:
+                parent = self._nodes.get(node.parent)
+                if parent is None:
+                    raise InvariantViolation(
+                        f"trie node at depth {node.depth} has a dangling "
+                        f"parent hash", shard=shard)
+                if parent.depth != node.depth - 1:
+                    raise InvariantViolation(
+                        f"trie parent depth {parent.depth} != "
+                        f"{node.depth} - 1", shard=shard)
+        orphans = self.pool.shared_tags() - set(self._nodes)
+        if orphans:
+            raise InvariantViolation(
+                f"{len(orphans)} shared allocation(s) in the pool are "
+                f"not trie nodes (orphaned shared pages)", shard=shard)

@@ -13,14 +13,31 @@ request may additionally wait for spillable cached pages, but it still
 blocks everything behind it; ``Request.prefix_hit_tokens`` records how
 many of its prompt tokens were served from shared pages instead of
 prefill (the benchmark's hit-rate column).
+
+Admission policy (ISSUE 10): the queue order and the load-shedding rule
+are PLUGGABLE. ``FifoPolicy`` (default) is the strict arrival order
+above and never sheds. ``DeadlinePolicy`` orders arrived requests by
+(priority desc, deadline, arrival) and SHEDS a queued request the
+moment its queue wait makes its deadline unreachable — the engine
+records it with a retriable ``errors.DeadlineExceeded`` instead of
+letting it occupy a slot it can no longer use, so goodput under
+overload degrades gracefully instead of collapsing. Every decision is
+a pure function of (request attributes, the arrival clock): the
+submission-order sequence number is only the final tie-break, so the
+shed/serve partition and the surviving streams are identical across
+join orders whenever arrivals (or deadlines) are distinct — the
+determinism contract tests/test_serving_robustness.py pins.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import math
 
 import numpy as np
+
+from cs336_systems_tpu.serving.errors import DeadlineExceeded
 
 
 @dataclasses.dataclass
@@ -31,6 +48,11 @@ class Request:
     stream (models/decode._sample) — the engine reproduces
     ``generate_kv_batched(..., row_keyed=True)`` row ``row`` bit-for-bit
     regardless of which slot serves it. Defaults to ``rid``.
+
+    ``deadline``: absolute clock value (same clock as ``arrival``) by
+    which the request wants its stream completed; None = no SLO. Only a
+    deadline-aware policy reads it. ``priority``: larger = served
+    earlier under such a policy; FIFO ignores it.
     """
 
     rid: int
@@ -38,6 +60,8 @@ class Request:
     max_new_tokens: int
     arrival: float = 0.0
     row: int | None = None
+    deadline: float | None = None
+    priority: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -54,10 +78,69 @@ class Request:
         self.prefix_hit_tokens: int = 0  # prompt tokens served from cache
 
 
-class Scheduler:
-    """FIFO admission queue keyed by (arrival, submission order)."""
+class AdmissionPolicy:
+    """Queue order + shed rule. ``order_key`` ranks ARRIVED requests
+    (lowest joins first); ``shed`` returns a retriable ``ServingError``
+    to reject a queued-and-arrived request with, or None to keep it.
+    Both must be pure functions of (request attributes, now) — ``seq``
+    (submission order) may appear only as the final tie-break."""
 
-    def __init__(self):
+    name = "fifo"
+
+    def order_key(self, req: Request, arrival: float, seq: int):
+        return (arrival, seq)
+
+    def shed(self, req: Request, now: float):
+        return None
+
+
+class FifoPolicy(AdmissionPolicy):
+    """Strict arrival order, never sheds — the ISSUE 8 semantics."""
+
+
+class DeadlinePolicy(AdmissionPolicy):
+    """Deadline-aware admission: serve (priority desc, earliest
+    deadline) first among arrived requests, and shed a queued request
+    once its deadline is unreachable.
+
+    ``token_time``: estimated service seconds per generated token. The
+    reachability test is ``now + max_new_tokens * token_time >
+    deadline``; the default 0.0 degrades to "shed once the deadline has
+    already passed in the queue" — still a strict improvement over FIFO
+    under overload (the expired request would burn a slot producing
+    tokens that can no longer count toward goodput) and free of any
+    service-rate model. A request with no deadline is never shed and
+    sorts after all deadlined peers of equal priority."""
+
+    name = "deadline"
+
+    def __init__(self, token_time: float = 0.0):
+        if token_time < 0:
+            raise ValueError(f"token_time must be >= 0, got {token_time}")
+        self.token_time = float(token_time)
+
+    def order_key(self, req: Request, arrival: float, seq: int):
+        dl = math.inf if req.deadline is None else float(req.deadline)
+        return (-req.priority, dl, arrival, seq)
+
+    def shed(self, req: Request, now: float):
+        if req.deadline is None:
+            return None
+        est = now + req.max_new_tokens * self.token_time
+        if est > req.deadline:
+            return DeadlineExceeded(
+                f"request {req.rid}: deadline {req.deadline:.4f} "
+                f"unreachable at t={now:.4f} (estimated completion "
+                f"{est:.4f}) — shed from the admission queue")
+        return None
+
+
+class Scheduler:
+    """Admission queue over a pluggable ``AdmissionPolicy`` (default:
+    strict FIFO keyed by (arrival, submission order))."""
+
+    def __init__(self, policy: AdmissionPolicy | None = None):
+        self.policy = policy if policy is not None else FifoPolicy()
         self._queue: list[tuple[float, int, Request]] = []
         self._seq = itertools.count()
 
@@ -68,15 +151,56 @@ class Scheduler:
     def __len__(self) -> int:
         return len(self._queue)
 
+    def __contains__(self, rid: int) -> bool:
+        return any(r.rid == rid for _, _, r in self._queue)
+
     def head(self, now: float) -> Request | None:
-        """The next admissible request (arrived by ``now``), without
-        removing it — the engine pops only once slot + pages are found."""
-        if self._queue and self._queue[0][0] <= now:
-            return self._queue[0][2]
+        """The next admissible request — the policy-order minimum among
+        those arrived by ``now`` — without removing it (the engine pops
+        only once slot + pages are found). Nothing behind the head may
+        bypass it: if IT cannot fit, admission blocks."""
+        arrived = [e for e in self._queue if e[0] <= now]
+        if not arrived:
+            return None
+        best = min(arrived,
+                   key=lambda e: self.policy.order_key(e[2], e[0], e[1]))
+        return best[2]
+
+    def pop(self, rid: int | None = None) -> Request:
+        """Remove and return the request ``rid`` (the engine passes the
+        ``head`` it just placed); plain FIFO front-pop when None."""
+        if rid is None:
+            return self._queue.pop(0)[2]
+        for i, (_, _, req) in enumerate(self._queue):
+            if req.rid == rid:
+                return self._queue.pop(i)[2]
+        raise KeyError(f"request {rid} is not queued")
+
+    def remove(self, rid: int) -> Request | None:
+        """Remove ``rid`` from the queue if present (cancellation of a
+        not-yet-admitted request); None when not queued."""
+        for i, (_, _, req) in enumerate(self._queue):
+            if req.rid == rid:
+                return self._queue.pop(i)[2]
         return None
 
-    def pop(self) -> Request:
-        return self._queue.pop(0)[2]
+    def shed_expired(self, now: float) -> list[tuple[Request, Exception]]:
+        """Apply the policy's shed rule to every ARRIVED queued request;
+        removed requests come back as (request, retriable error) pairs
+        for the engine to record. FIFO never sheds; the sweep covers the
+        whole queue (not just the head) so a blocked head cannot hide an
+        expired request behind it."""
+        shed, keep = [], []
+        for entry in self._queue:
+            arrival, _, req = entry
+            err = (self.policy.shed(req, now)
+                   if arrival <= now else None)
+            if err is None:
+                keep.append(entry)
+            else:
+                shed.append((req, err))
+        self._queue = keep
+        return shed
 
     def next_arrival(self) -> float | None:
         """Earliest queued arrival time (for the benchmark's idle wait)."""

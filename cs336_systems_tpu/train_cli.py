@@ -692,6 +692,11 @@ def main(argv=None) -> None:
             except Exception:  # noqa: BLE001 — internal API, may move
                 return None
 
+    # non-finite sentinel (ISSUE 10): cumulative counts of steps whose
+    # loss / grad_norm came back NaN or inf — detection only (the run is
+    # NOT stopped; a blow-up's onset step is what the JSONL is for)
+    nonfinite_loss = nonfinite_grad = 0
+
     t0 = time.perf_counter()
     tokens_done = 0
     step_i = step_saved = start_step
@@ -723,16 +728,33 @@ def main(argv=None) -> None:
             # float(loss) is the hard device fence: wall below reflects
             # COMPLETED work, not the async dispatch queue (CLAUDE.md)
             loss_val = float(loss)
+            gnorm_val = float(gnorm) if gnorm is not None else None
+            if not np.isfinite(loss_val):
+                nonfinite_loss += 1
+                if nonfinite_loss == 1:
+                    print(f"WARNING: non-finite loss ({loss_val}) first "
+                          f"seen at step {step_i} — training continues; "
+                          f"see the telemetry JSONL's nonfinite_loss "
+                          f"column for the onset")
+            if gnorm_val is not None and not np.isfinite(gnorm_val):
+                nonfinite_grad += 1
+                if nonfinite_grad == 1:
+                    print(f"WARNING: non-finite grad_norm ({gnorm_val}) "
+                          f"first seen at step {step_i} — training "
+                          f"continues; see the telemetry JSONL's "
+                          f"nonfinite_grad column for the onset")
             wall = time.perf_counter() - t0
             tele.write(json.dumps({
                 "step": step_i,
                 "loss": round(loss_val, 6),
-                "grad_norm": (round(float(gnorm), 6)
-                              if gnorm is not None else None),
+                "grad_norm": (round(gnorm_val, 6)
+                              if gnorm_val is not None else None),
                 "tokens_per_s": round(tokens_done / wall, 1),
                 "live_buffer_bytes": live_buffer_bytes(),
                 "analyzed_peak_hbm_bytes": analyzed_peak,
                 "recompile_count": _recompile_count(),
+                "nonfinite_loss": nonfinite_loss,
+                "nonfinite_grad": nonfinite_grad,
                 "wall_s": round(wall, 3),
             }) + "\n")
             tele.flush()

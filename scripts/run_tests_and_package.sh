@@ -181,6 +181,30 @@ if [ "$ce_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$ce_status
 
+# servesan gate (ISSUE 10): the serving chaos harness — EVERY seeded
+# fault class must surface its expected typed serving error (exit 0 per
+# fault; a missed/misclassified detection exits 1, a broken trace 2),
+# then the clean run must drain with zero findings. Iterates --list so
+# a fault class added to serving/chaos.py is gated automatically.
+servesan_status=0
+for fault in $(JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m cs336_systems_tpu.serving.chaos --list --json \
+        | python -c "import json,sys; print(' '.join(json.load(sys.stdin)['faults']))"); do
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.serving.chaos --fault "$fault" --json \
+        > "/tmp/servesan_$fault.json" \
+        || { servesan_status=$?; echo "servesan: fault $fault FAILED" >&2; }
+done
+if [ "$servesan_status" -eq 0 ]; then
+    # the full matrix (all faults + the clean false-positive run) on the
+    # sharded engine too — detection must not be a single-device accident
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.serving.chaos --mesh dp8 --json \
+        > /tmp/servesan_dp8.json
+    servesan_status=$?
+fi
+[ "$status" -eq 0 ] && status=$servesan_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

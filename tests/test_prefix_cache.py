@@ -38,6 +38,7 @@ from cs336_systems_tpu.parallel.mesh import make_mesh
 from cs336_systems_tpu.serving import (
     PagePool,
     PrefixCache,
+    RefcountViolation,
     Request,
     ServingEngine,
 )
@@ -130,11 +131,11 @@ class TestSharedPool:
     def test_early_and_double_release_raise(self):
         pool = PagePool(2)
         pages = pool.alloc_shared(1, "t")
-        with pytest.raises(KeyError, match="release"):
+        with pytest.raises(RefcountViolation, match="release"):
             pool.release("ghost")
         pool.acquire(pages, "r")
         pool.release("r")
-        with pytest.raises(KeyError, match="release"):
+        with pytest.raises(RefcountViolation, match="release"):
             pool.release("r")
 
     def test_acquire_of_unshared_page_raises(self):
@@ -174,7 +175,8 @@ class TestSharedPool:
         pool = PagePool(4)
         pages = pool.alloc_shared(1, "t")
         pool.acquire(pages, "r")
-        with pytest.raises(AssertionError, match="block tables"):
+        # ISSUE 10: refcount drift is the typed RefcountViolation
+        with pytest.raises(RefcountViolation, match="block tables"):
             pool.check_conserved(block_tables=[[3]])  # table lost the page
 
     def test_shared_counted_once_and_drain_gate(self):

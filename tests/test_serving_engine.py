@@ -23,7 +23,13 @@ from cs336_systems_tpu.models.transformer import (
     init_transformer_lm,
 )
 from cs336_systems_tpu.parallel.mesh import make_mesh
-from cs336_systems_tpu.serving import PagePool, Request, Scheduler, ServingEngine
+from cs336_systems_tpu.serving import (
+    PagePool,
+    RefcountViolation,
+    Request,
+    Scheduler,
+    ServingEngine,
+)
 
 CFG = TransformerConfig(
     vocab_size=64, context_length=64, d_model=64,
@@ -101,7 +107,9 @@ class TestPagePool:
         with pytest.raises(ValueError):
             pool.alloc(1, "a")
         pool.free("a")
-        with pytest.raises(KeyError):
+        # ISSUE 10: ownership misuse is the typed RefcountViolation
+        # (still a ValueError via compat subclassing)
+        with pytest.raises(RefcountViolation):
             pool.free("a")
 
     def test_leak_detection(self):

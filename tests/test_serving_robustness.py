@@ -1,0 +1,340 @@
+"""Chaos-hardened serving (ISSUE 10): the typed failure surface, the
+request lifecycle (cancellation, deadline shedding, poisoned-slot
+containment), and the servesan fault matrix.
+
+The load-bearing property everywhere: robustness actions are HOST-SIDE
+schedule edits, so every surviving stream stays bit-identical to the
+row-keyed oracle (``generate_kv_batched(row_keyed=True, page_block=)``)
+no matter what was cancelled, shed or poisoned around it, in what order
+requests joined, or how the slots shard over dp8 / dp2×tp4 — the same
+oracle discipline as tests/test_serving_engine.py. The fault matrix is
+the gradsan discipline (PR 6): every detector must have SEEN its fault.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.models.decode import generate_kv_batched
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.serving import (
+    AdmissionImpossible,
+    CorruptBlockTable,
+    DeadlineExceeded,
+    DeadlinePolicy,
+    FifoPolicy,
+    InvariantViolation,
+    PoolExhausted,
+    RefcountViolation,
+    Request,
+    ServingEngine,
+    ServingError,
+    SlotPoisoned,
+    chaos,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 10
+LENS = [12, 3, 7, 1, 12, 5, 9, 2]  # test_serving_engine's skew profile
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+            for n in LENS]
+
+
+@pytest.fixture(scope="module")
+def oracle(params, prompts):
+    pmax = max(p.size for p in prompts)
+    padded = np.zeros((len(prompts), pmax), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :p.size] = p
+    return np.asarray(generate_kv_batched(
+        params, CFG, padded, NEW, jax.random.PRNGKey(0), temperature=0.9,
+        top_k=8, row_keyed=True, prompt_lens=[p.size for p in prompts],
+        page_block=BLK))
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=4, n_pages=16,
+                max_blocks=4, page_block=BLK, temperature=0.9, top_k=8)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+def _ticker():
+    it = iter(np.arange(0.0, 1e4, 0.5))
+    return lambda: next(it)
+
+
+# --- the typed failure surface -----------------------------------------
+
+
+class TestErrorTaxonomy:
+    def test_retriable_flags(self):
+        # transient capacity/latency/numerics faults invite a retry;
+        # ownership/table/invariant corruption never does
+        assert PoolExhausted.retriable
+        assert DeadlineExceeded.retriable
+        assert SlotPoisoned.retriable
+        assert not RefcountViolation.retriable
+        assert not CorruptBlockTable.retriable
+        assert not AdmissionImpossible.retriable
+        assert not InvariantViolation.retriable
+
+    def test_compat_bases(self):
+        # pre-ISSUE-10 callers caught MemoryError / ValueError /
+        # AssertionError from these seams; the typed errors keep those
+        # contracts via dual inheritance
+        assert issubclass(PoolExhausted, MemoryError)
+        assert issubclass(RefcountViolation, ValueError)
+        assert issubclass(CorruptBlockTable, ValueError)
+        assert issubclass(AdmissionImpossible, ValueError)
+        assert issubclass(InvariantViolation, AssertionError)
+        for cls in (PoolExhausted, DeadlineExceeded, SlotPoisoned,
+                    RefcountViolation, CorruptBlockTable,
+                    AdmissionImpossible, InvariantViolation):
+            assert issubclass(cls, ServingError)
+
+    def test_shard_attribution(self):
+        e = RefcountViolation("page 3 double free", shard=2)
+        assert e.shard == 2 and e.detail == "page 3 double free"
+        assert str(e) == "shard 2: page 3 double free"
+        assert RefcountViolation("x").shard is None
+        assert str(InvariantViolation("pool not conserved")) == \
+            "pool not conserved"
+
+
+# --- exhaustive submit-time rejection ----------------------------------
+
+
+def test_submit_rejects_every_impossible_request(params):
+    """Every never-admittable request dies AT SUBMIT with the
+    non-retriable AdmissionImpossible — it must not occupy queue space
+    waiting for evictions that cannot help it."""
+    eng = _engine(params, n_pages=2, max_blocks=2)
+    with pytest.raises(AdmissionImpossible, match="context_length"):
+        eng.submit(Request(rid=0, prompt=np.zeros(8, np.int32),
+                           max_new_tokens=CFG.context_length))
+    with pytest.raises(AdmissionImpossible, match="pages"):
+        eng.submit(Request(rid=1, prompt=np.zeros(17, np.int32),
+                           max_new_tokens=8))  # 4 pages > pool's 2
+    # 3 blocks > 2-wide tables, but a 3-page pool could hold it: the
+    # block-table width is its own independent impossibility
+    eng3 = _engine(params, n_pages=3, max_blocks=2)
+    with pytest.raises(AdmissionImpossible, match="blocks"):
+        eng3.submit(Request(rid=2, prompt=np.zeros(17, np.int32),
+                            max_new_tokens=7))
+    eng.submit(Request(rid=3, prompt=np.zeros(4, np.int32),
+                       max_new_tokens=4))
+    with pytest.raises(AdmissionImpossible, match="duplicate"):
+        eng.submit(Request(rid=3, prompt=np.zeros(4, np.int32),
+                           max_new_tokens=4))
+    assert not AdmissionImpossible.retriable
+    assert isinstance(AdmissionImpossible("x"), ValueError)  # compat
+
+
+def test_engine_rejects_degenerate_geometry(params):
+    with pytest.raises(ValueError, match="slots"):
+        _engine(params, slots=0)
+    with pytest.raises(ValueError, match="page"):
+        _engine(params, n_pages=0)
+    with pytest.raises(ValueError, match="page"):
+        _engine(params, max_blocks=0)
+
+
+# --- cancellation ------------------------------------------------------
+
+
+def test_cancel_running_and_queued_vs_oracle(params, prompts, oracle):
+    """Cancel one RUNNING and one QUEUED request mid-trace: both land in
+    ``cancelled`` (partial stream = oracle prefix; queued = empty), and
+    every surviving stream is bit-identical to an oracle that never saw
+    the cancellations — tokens are row-local."""
+    eng = _engine(params)  # 4 slots: rids 0-3 run, 4-7 queue
+    for r, p in enumerate(prompts):
+        eng.submit(Request(rid=r, prompt=p, max_new_tokens=NEW))
+    eng.step(0.0)
+    eng.step(0.5)
+    assert eng.cancel(1, now=1.0)   # running, 2 tokens streamed
+    assert eng.cancel(6, now=1.0)   # still queued, never ran
+    res = eng.run(time_fn=_ticker())
+    eng.check_idle()
+
+    assert set(res) == {0, 2, 3, 4, 5, 7}
+    assert set(eng.cancelled) == {1, 6} and not eng.failed
+    np.testing.assert_array_equal(eng.cancelled[1], oracle[1][:2])
+    assert eng.cancelled[6].size == 0
+    for r in res:
+        np.testing.assert_array_equal(res[r], oracle[r])
+
+
+def test_cancel_is_idempotent(params, prompts):
+    eng = _engine(params)
+    eng.submit(Request(rid=0, prompt=prompts[0], max_new_tokens=2))
+    assert not eng.cancel(99)        # unknown rid
+    eng.run()
+    assert not eng.cancel(0)         # already finished
+    assert 0 in eng.results and not eng.cancelled
+
+
+# --- deadline-aware admission ------------------------------------------
+
+# arrivals r*0.1; rids 0/1/6/7 get a reachable 12-unit budget, the
+# middle rids 2-5 a 2-unit budget that 10 decode steps at 0.5/step can
+# never meet — the doomed middle FIFO wastes two waves serving
+_DEADLINE = {0: 12.0, 1: 12.0, 2: 2.0, 3: 2.0, 4: 2.0, 5: 2.0,
+             6: 12.0, 7: 12.0}
+
+
+def _deadline_requests(prompts):
+    return [Request(rid=r, prompt=p, max_new_tokens=NEW, arrival=r * 0.1,
+                    deadline=r * 0.1 + _DEADLINE[r])
+            for r, p in enumerate(prompts)]
+
+
+def _run_deadline(params, prompts, policy, order=None):
+    eng = _engine(params, slots=2, n_pages=8, policy=policy)
+    reqs = _deadline_requests(prompts)
+    for i in (order if order is not None else range(len(reqs))):
+        eng.submit(reqs[i])
+    res = eng.run(time_fn=_ticker())
+    eng.check_idle()
+    return eng, reqs, res
+
+
+def _deadline_goodput(reqs, res):
+    return sum(len(r.tokens) for r in reqs
+               if r.rid in res and r.finish_time <= r.deadline)
+
+
+def test_deadline_policy_beats_fifo_goodput(params, prompts):
+    """The acceptance criterion: under overload the deadline policy's
+    goodput (tokens from requests that finished BY their deadline) is
+    STRICTLY higher than strict FIFO's on the same virtual-clock trace,
+    and every shed request got the retriable typed DeadlineExceeded."""
+    fifo_eng, fifo_reqs, fifo_res = _run_deadline(
+        params, prompts, FifoPolicy())
+    assert set(fifo_res) == set(range(8)) and not fifo_eng.failed
+
+    dl_eng, dl_reqs, dl_res = _run_deadline(
+        params, prompts, DeadlinePolicy(token_time=0.5))
+    assert set(dl_eng.failed) == {2, 3, 4, 5}
+    for err in dl_eng.failed.values():
+        assert isinstance(err, DeadlineExceeded) and err.retriable
+
+    assert _deadline_goodput(dl_reqs, dl_res) > \
+        _deadline_goodput(fifo_reqs, fifo_res)
+    # fewer steps too: the doomed middle never occupied a slot
+    assert dl_eng.steps < fifo_eng.steps
+
+
+def test_deadline_shed_deterministic_across_join_orders(
+        params, prompts, oracle):
+    """Shedding is a function of the ARRIVAL clock, not submission
+    order: permuted submit orders (distinct arrivals) shed the same
+    rids at the same step count, and every surviving stream equals its
+    oracle row."""
+    outcomes = []
+    for order in ([5, 2, 7, 0, 3, 6, 1, 4], [7, 6, 5, 4, 3, 2, 1, 0],
+                  None):
+        eng, _reqs, res = _run_deadline(
+            params, prompts, DeadlinePolicy(token_time=0.5), order=order)
+        outcomes.append((set(eng.failed), set(res), eng.steps))
+        for r in res:
+            np.testing.assert_array_equal(res[r], oracle[r])
+    assert outcomes[0] == outcomes[1] == outcomes[2]
+    assert outcomes[0][0] == {2, 3, 4, 5}
+
+
+# --- poisoned-slot containment -----------------------------------------
+
+
+def test_poisoned_slot_contained_vs_oracle(params, prompts, oracle):
+    """NaN-poison one slot's carried logits mid-stream: that request is
+    evicted with the retriable SlotPoisoned (tokens streamed before the
+    poison stay valid — they came from finite logits), the trace drains,
+    and every OTHER stream is bit-identical to the oracle."""
+    eng = _engine(params)
+    for r, p in enumerate(prompts):
+        eng.submit(Request(rid=r, prompt=p, max_new_tokens=NEW))
+    eng.step(0.0)
+    eng.step(0.5)
+    slot = next(s for s, rq in eng.running.items() if rq.rid == 2)
+    eng.logits[slot, :5] = np.nan
+    res = eng.run(time_fn=_ticker())
+    eng.check_idle()
+
+    assert set(res) == set(range(8)) - {2}
+    err = eng.failed[2]
+    assert isinstance(err, SlotPoisoned) and err.retriable
+    assert err.shard == slot // eng.slots_per
+    assert "non-finite" in str(err)
+    for r in res:
+        np.testing.assert_array_equal(res[r], oracle[r])
+
+
+# --- the servesan fault matrix -----------------------------------------
+
+
+@pytest.mark.parametrize("mesh", ["dp8", "dp2xtp4"])
+def test_chaos_matrix_detects_every_fault(mesh):
+    """Every seeded fault class must surface its EXPECTED typed error
+    (from the self_check sweep or the engine's own operation), and the
+    un-injected trace must drain with zero findings — on sharded slot
+    batches, not just single-device."""
+    rows = [chaos.run_fault(name, mesh) for name in chaos.fault_names()]
+    rows.append(chaos.run_clean(mesh))
+    bad = [(r["fault"], r.get("error")) for r in rows if not r["ok"]]
+    assert not bad, f"chaos verdicts failed on {mesh}: {bad}"
+    assert len(rows) == len(chaos.fault_names()) + 1 >= 9
+
+
+def test_chaos_clean_run_zero_findings_single_device():
+    row = chaos.run_clean("none")
+    assert row["ok"] and not row["detected"]
+    assert row["all_requests_completed"]
+
+
+def test_chaos_cli_contract():
+    """The CLI is the CI gate: --list enumerates ≥8 fault classes fast
+    (no engine build), a single-fault run reports ok with exit 0, and an
+    unknown fault is the exit-2 build error, not a miss."""
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+    base = [sys.executable, "-m", "cs336_systems_tpu.serving.chaos"]
+
+    ls = subprocess.run(base + ["--list", "--json"], env=env,
+                        capture_output=True, text=True)
+    assert ls.returncode == 0
+    assert len(json.loads(ls.stdout)["faults"]) >= 8
+
+    one = subprocess.run(base + ["--fault", "nan-logits", "--json"],
+                         env=env, capture_output=True, text=True)
+    assert one.returncode == 0, one.stdout + one.stderr
+    row = json.loads(one.stdout)["rows"][0]
+    assert row["ok"] and row["error"]["type"] == "SlotPoisoned"
+    assert row["error"]["retriable"] is True
+
+    bad = subprocess.run(base + ["--fault", "no-such-fault", "--json"],
+                         env=env, capture_output=True, text=True)
+    assert bad.returncode == 2
