@@ -1,0 +1,613 @@
+"""trainsan — training-plane chaos harness: seeded checkpoint/blow-up
+faults against a real short training run (ISSUE 11).
+
+The training twin of servesan (``serving/chaos.py``), completing the
+repo's sanitizer pattern (gradsan → servesan → trainsan): seed the
+fault, prove the TYPED detector fires, prove recovery is bit-exact.
+Each fault class is injected into a real ``train_cli`` run (tiny model,
+synthetic corpus, checkpoints every 2 steps) and must (a) surface its
+expected typed ``utils/errors.py`` error — or, for the blow-up fault,
+the recovery counters in the telemetry JSONL — and (b) end with a
+recovered run whose per-step loss curve matches the uninterrupted
+oracle number for number (the step-keyed data stream + verified
+restore make this exact, not approximate). The clean run must report
+zero findings and be bit-identical to a run with recovery disabled
+(recovery is host-side only).
+
+Fault classes (``--list``):
+
+    kill-mid-save           save killed between file writes (the
+                            ``checkpoint._FAULT_HOOK`` seam, iterated
+                            over kill points incl. post-publish) →
+                            torn temp never verifies (TornCheckpoint),
+                            resume falls back to the newest intact
+                            version and replays exactly
+    corrupt-leaf-bytes      one byte flipped mid-params.npz → DigestMismatch
+    truncated-npz           params.npz cut to half size → TornCheckpoint
+    stale-latest            LATEST points at a deleted version → TornCheckpoint
+    manifest-digest-drift   manifest digest edited → DigestMismatch
+    missing-opt-state       opt_state.npz deleted from a published
+                            version → TornCheckpoint (the typed
+                            replacement for the old stale-sibling
+                            silent params/opt mispairing)
+    config-mismatch         resume with different model flags →
+                            ConfigMismatch (not retriable: no fallback)
+    nan-grad-at-step-k      two consecutive poisoned steps via the
+                            ``train_cli._STEP_FAULT_HOOK`` seam →
+                            skip + rollback + deterministic replay,
+                            post-recovery curve == oracle
+
+Matrix: ``--mode single|dp|zero1`` (single device, bucketed DP, and
+DP+ZeRO-1 so the ``[world, chunk]`` optimizer re-placement path is
+exercised). Verdicts must be identical across modes.
+
+CLI (gradsan shape)::
+
+    PALLAS_AXON_POOL_IPS= python -m cs336_systems_tpu.analysis.trainsan --list
+    ... --fault corrupt-leaf-bytes --json
+    ... --mode zero1
+
+Exit status: 0 all detected + clean run clean, 1 MISSED / false
+positive / recovery not bit-exact, 2 build error.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (same
+# pattern as gradsan/lint); CS336_TPU_TRAINSAN=1 opts out.
+if not os.environ.get("CS336_TPU_TRAINSAN"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import contextlib
+import io
+import json
+import re
+import shutil
+import sys
+import tempfile
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if not os.environ.get("CS336_TPU_TRAINSAN"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu import train_cli
+from cs336_systems_tpu.utils import checkpoint as ckpt
+from cs336_systems_tpu.utils.errors import (
+    CheckpointError,
+    ConfigMismatch,
+    DigestMismatch,
+    TornCheckpoint,
+)
+
+STEPS = 8
+CKPT_EVERY = 2
+ROLLBACK_AFTER = 2
+NAN_STEPS = (6, 7)  # two consecutive → exactly one rollback
+
+# tiny config shared with tests/test_train_cli.py's TINY shape
+_TINY = [
+    "--size", "small", "--layers", "2", "--d-model", "64", "--d-ff", "128",
+    "--heads", "4", "--ctx", "32", "--vocab", "64", "--batch", "8",
+    "--warmup", "1", "--synthetic", "--log-every", "0",
+]
+
+MODE_ARGS = {
+    "single": ["--parallel", "none"],
+    "dp": ["--parallel", "bucketed"],
+    "zero1": ["--parallel", "zero1"],
+}
+
+# kill points for kill-mid-save: between the first two data files (torn
+# temp, no manifest), and after publish but before the LATEST flip (the
+# legal window where the pointer lags the newest version)
+KILL_POINTS = ("file:params.npz", "file:opt_state.npz", "published")
+
+
+class TrainsanBuildError(RuntimeError):
+    """Unknown fault/mode or harness plumbing failure (CLI exit 2)."""
+
+
+class _InjectedKill(RuntimeError):
+    """Raised from the checkpoint fault hook to simulate a preemption."""
+
+
+def fault_names() -> list[str]:
+    return list(_FAULTS)
+
+
+def _read_tele(path: str):
+    """(last-line-wins {step: loss}, final record) from a telemetry
+    JSONL — replayed steps overwrite their poisoned first attempt."""
+    losses: dict[int, float] = {}
+    last = None
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            losses[rec["step"]] = rec["loss"]
+            last = rec
+    return losses, last
+
+
+def _tail_matches(oracle: dict[int, float], got: dict[int, float]) -> bool:
+    """Every step the recovered run logged must equal the oracle's value
+    for the same step, and it must have logged something."""
+    if not got:
+        return False
+    return all(k in oracle and oracle[k] == v for k, v in got.items())
+
+
+def _newest_version(root: str) -> str:
+    versions = ckpt._version_dirs(root)
+    if not versions:
+        raise TrainsanBuildError(f"no published versions under {root}")
+    return os.path.join(root, versions[-1][1])
+
+
+def _flip_byte_mid(path: str) -> None:
+    with open(path, "r+b") as f:
+        data = f.read()
+        i = len(data) // 2
+        f.seek(i)
+        f.write(bytes([data[i] ^ 0xFF]))
+
+
+def _truncate_half(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+class Harness:
+    """One (mode, seed) cell: owns a scratch dir, a cached oracle run,
+    and runs fault scenarios against copies of the oracle's checkpoint
+    store. Close (or use as a context manager) to reclaim the scratch."""
+
+    def __init__(self, mode: str = "single", seed: int = 0):
+        if mode not in MODE_ARGS:
+            raise TrainsanBuildError(
+                f"unknown mode {mode!r} (choose from {list(MODE_ARGS)})")
+        self.mode, self.seed = mode, seed
+        self.root = tempfile.mkdtemp(prefix=f"trainsan-{mode}-")
+        self._oracle = None
+        self._n = 0
+
+    def close(self):
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _scratch(self, tag: str) -> str:
+        self._n += 1
+        d = os.path.join(self.root, f"{self._n:03d}-{tag}")
+        os.makedirs(d)
+        return d
+
+    def _cli(self, *, ckdir: str, telemetry: str | None = None,
+             recover: bool = True, extra: list[str] = ()) -> str:
+        argv = list(_TINY) + MODE_ARGS[self.mode] + [
+            "--seed", str(self.seed), "--steps", str(STEPS),
+            "--checkpoint-dir", ckdir, "--checkpoint-every",
+            str(CKPT_EVERY), "--keep", "0",
+        ]
+        if recover:
+            argv += ["--skip-nonfinite",
+                     "--rollback-after", str(ROLLBACK_AFTER)]
+        if telemetry:
+            argv += ["--telemetry", telemetry]
+        argv += list(extra)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            train_cli.main(argv)
+        return buf.getvalue()
+
+    def oracle(self) -> dict:
+        """The uninterrupted run (recovery armed, no fault): loss curve
+        + a complete checkpoint store at steps 0,2,4,6,8. Cached —
+        every fault scenario corrupts a COPY of its store."""
+        if self._oracle is None:
+            d = self._scratch("oracle")
+            ckdir, tele = os.path.join(d, "ck"), os.path.join(d, "t.jsonl")
+            out = self._cli(ckdir=ckdir, telemetry=tele)
+            losses, last = _read_tele(tele)
+            if last is None or last["skipped_steps"] or last["rollbacks"]:
+                raise TrainsanBuildError(
+                    "oracle run tripped recovery with no fault injected:\n"
+                    + out)
+            self._oracle = {"losses": losses, "ckdir": ckdir, "last": last}
+        return self._oracle
+
+    def _corrupt_copy(self, tag: str, corrupt) -> str:
+        """Copy the oracle's checkpoint store and apply ``corrupt`` to
+        the copy. Returns the damaged store root."""
+        dst = os.path.join(self._scratch(tag), "ck")
+        shutil.copytree(self.oracle()["ckdir"], dst)
+        corrupt(dst)
+        return dst
+
+    def _typed_load_error(self, root: str, expect_config=None):
+        try:
+            ckpt.load_checkpoint(root, expect_config=expect_config)
+        except CheckpointError as e:
+            return e
+        return None
+
+    def _resume_and_compare(self, root: str) -> tuple[bool, str, dict]:
+        """--resume against a (possibly damaged) store; returns
+        (curve-matches-oracle, stdout, resumed losses)."""
+        tele = os.path.join(self._scratch("resume"), "t.jsonl")
+        out = self._cli(ckdir=root, telemetry=tele, extra=["--resume"])
+        losses, _ = _read_tele(tele)
+        return _tail_matches(self.oracle()["losses"], losses), out, losses
+
+    def _row(self, fault, expected, pattern, detected, recovered,
+             err=None, detail=None) -> dict:
+        ok = bool(detected and recovered)
+        return {
+            "fault": fault,
+            "mode": self.mode,
+            "seed": self.seed,
+            "expected": expected,
+            "pattern": pattern,
+            "detected": bool(detected),
+            "recovered": bool(recovered),
+            "ok": ok,
+            "error": None if err is None else {
+                "type": type(err).__name__,
+                "retriable": getattr(err, "retriable", None),
+                "path": getattr(err, "path", None),
+                "message": str(err),
+            },
+            "detail": detail,
+        }
+
+    # -- corruption-family faults --------------------------------------
+
+    def _run_corruption(self, fault, corrupt, expected_cls, pattern) -> dict:
+        root = self._corrupt_copy(fault, corrupt)
+        err = self._typed_load_error(root)
+        detected = isinstance(err, expected_cls) and bool(
+            re.search(pattern, str(err)))
+        # walk-back must land on the newest UNdamaged version (step 6 —
+        # step 8 is the one every corruption targets)
+        fallback_ok, fb_step = False, None
+        try:
+            _, fb_step = ckpt.find_latest_intact(root)
+            fallback_ok = fb_step == STEPS - CKPT_EVERY
+        except CheckpointError:
+            pass
+        match, out, losses = self._resume_and_compare(root)
+        recovered = fallback_ok and match and "resumed" in out
+        return self._row(
+            fault, [c.__name__ for c in (
+                expected_cls if isinstance(expected_cls, tuple)
+                else (expected_cls,))],
+            pattern, detected, recovered, err,
+            detail={"fallback_step": fb_step if fallback_ok else None,
+                    "resumed_steps": sorted(losses)},
+        )
+
+    # -- fault runners --------------------------------------------------
+
+    def run_fault(self, name: str) -> dict:
+        if name not in _FAULTS:
+            raise TrainsanBuildError(
+                f"unknown fault {name!r} (see --list)")
+        return _FAULTS[name](self)
+
+    def run_clean(self) -> dict:
+        """Recovery armed + no fault must (a) trip nothing and (b) be
+        bit-identical to recovery DISABLED — the recovery policy is
+        host-side bookkeeping, never math."""
+        orc = self.oracle()
+        d = self._scratch("norecover")
+        ckdir, tele = os.path.join(d, "ck"), os.path.join(d, "t.jsonl")
+        self._cli(ckdir=ckdir, telemetry=tele, recover=False)
+        losses, _ = _read_tele(tele)
+        inert = (losses == orc["losses"]
+                 and orc["last"]["skipped_steps"] == 0
+                 and orc["last"]["rollbacks"] == 0
+                 and orc["last"]["nonfinite_onset_step"] is None)
+        return self._row(
+            "clean", ["(zero findings)"], "",
+            detected=not inert,  # a finding here is a FALSE POSITIVE
+            recovered=True, detail={"recovery_on_equals_off": inert},
+        ) | {"ok": inert, "detected": not inert}
+
+    def run_all(self) -> list[dict]:
+        rows = [self.run_fault(name) for name in fault_names()]
+        rows.append(self.run_clean())
+        return rows
+
+
+# -- individual faults --------------------------------------------------
+
+
+def _fault_kill_mid_save(h: Harness) -> dict:
+    """Head run killed during the step-6 save, at every kill point; each
+    leftover must be harmless and --resume must replay to the oracle
+    curve exactly (the kill-at-any-point durability acceptance)."""
+    orc = h.oracle()
+    arm_event = "begin:" + ckpt._STEP_FMT.format(STEPS - CKPT_EVERY)
+    results = []
+    torn_errs = []
+    for point in KILL_POINTS:
+        d = h._scratch(f"kill-{point.replace(':', '-')}")
+        ckdir, tele = os.path.join(d, "ck"), os.path.join(d, "t.jsonl")
+        armed = {"on": False}
+
+        def hook(event, _point=point, _armed=armed):
+            if event == arm_event:
+                _armed["on"] = True
+            elif _armed["on"] and event == _point:
+                _armed["on"] = False
+                raise _InjectedKill(_point)
+
+        ckpt._FAULT_HOOK = hook
+        killed = False
+        try:
+            h._cli(ckdir=ckdir, telemetry=tele)
+        except _InjectedKill:
+            killed = True
+        finally:
+            ckpt._FAULT_HOOK = None
+        if not killed:
+            raise TrainsanBuildError(
+                f"kill point {point} never fired (save protocol changed?)")
+        if point.startswith("file:"):
+            # the torn temp dir has no manifest → typed TornCheckpoint
+            torn = [e for e in os.listdir(ckdir) if e.startswith(".tmp-")]
+            err = None
+            if torn:
+                err = h._typed_load_error(os.path.join(ckdir, torn[0]))
+            torn_errs.append(
+                isinstance(err, TornCheckpoint)
+                and bool(re.search(r"interrupted before publish",
+                                   str(err))))
+            want_fb = STEPS - 2 * CKPT_EVERY  # step 4: the 6-save died
+        else:
+            # killed after publish, before the LATEST flip: the version
+            # is durable, only the pointer lags
+            want_fb = STEPS - CKPT_EVERY
+        fb_ok = False
+        try:
+            _, fb_step = ckpt.find_latest_intact(ckdir)
+            fb_ok = fb_step == want_fb
+        except CheckpointError:
+            pass
+        match, out, losses = h._resume_and_compare(ckdir)
+        results.append({
+            "point": point, "fallback_ok": fb_ok, "curve_match": match,
+            "resumed_from": out.split("at step")[-1].split("\n")[0].strip()
+            if "at step" in out else None,
+        })
+    detected = bool(torn_errs) and all(torn_errs)
+    recovered = all(r["fallback_ok"] and r["curve_match"] for r in results)
+    return h._row(
+        "kill-mid-save", ["TornCheckpoint"], r"interrupted before publish",
+        detected, recovered,
+        err=None, detail={"kill_points": results},
+    )
+
+
+def _fault_nan_grad(h: Harness) -> dict:
+    """Poison steps 6 and 7 once each through the train_cli seam: the
+    policy must skip both, roll back to the step-4 checkpoint, and the
+    replayed curve must equal the oracle at EVERY step (the poisoned
+    attempts are overwritten by their clean replays in the JSONL)."""
+    orc = h.oracle()
+    d = h._scratch("nan-grad")
+    ckdir, tele = os.path.join(d, "ck"), os.path.join(d, "t.jsonl")
+    poisoned: set[int] = set()
+
+    def hook(step_no, state, loss):
+        if step_no in NAN_STEPS and step_no not in poisoned:
+            poisoned.add(step_no)
+            state = jax.tree_util.tree_map(
+                lambda l: l * jnp.nan
+                if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                else l,
+                state,
+            )
+            loss = float("nan")
+        return state, loss
+
+    train_cli._STEP_FAULT_HOOK = hook
+    try:
+        out = h._cli(ckdir=ckdir, telemetry=tele)
+    finally:
+        train_cli._STEP_FAULT_HOOK = None
+    losses, last = _read_tele(tele)
+    detected = (
+        last is not None
+        and last["skipped_steps"] == len(NAN_STEPS)
+        and last["rollbacks"] == 1
+        and last["nonfinite_onset_step"] == NAN_STEPS[0]
+        and "RECOVERY" in out
+    )
+    recovered = losses == orc["losses"]
+    return h._row(
+        "nan-grad-at-step-k",
+        ["skipped_steps/rollbacks telemetry"], r"RECOVERY",
+        detected, recovered,
+        detail={"final": {k: last.get(k) for k in (
+            "skipped_steps", "rollbacks", "nonfinite_onset_step",
+            "nonfinite_loss")} if last else None},
+    )
+
+
+def _fault_config_mismatch(h: Harness) -> dict:
+    """Resume with different model flags: the typed non-retriable
+    ConfigMismatch must abort the run (NO silent fallback — older
+    versions share the same config), and a correct-config resume must
+    still find the store fully intact."""
+    orc = h.oracle()
+    root = h._corrupt_copy("config-mismatch", lambda _root: None)
+    # direct typed check against the manifest's recorded config hash
+    with open(os.path.join(_newest_version(root),
+                           "model_config.json")) as f:
+        cfg = json.load(f)
+    cfg["d_model"] = cfg["d_model"] * 2
+    err = h._typed_load_error(root, expect_config=cfg)
+    direct_ok = isinstance(err, ConfigMismatch) and not err.retriable
+    # through the CLI: must SystemExit naming the typed error
+    cli_ok = False
+    try:
+        h._cli(ckdir=root, extra=["--resume", "--d-model", "32"])
+    except SystemExit as e:
+        cli_ok = "ConfigMismatch" in str(e)
+    # recovery: the correct config still resumes from the intact newest
+    match, out, losses = h._resume_and_compare(root)
+    resumed_at_end = f"at step {STEPS}" in out
+    recovered = resumed_at_end and (match or not losses)
+    return h._row(
+        "config-mismatch", ["ConfigMismatch"], r"different model config",
+        direct_ok and cli_ok, recovered, err,
+        detail={"cli_systemexit": cli_ok},
+    )
+
+
+def _mk_corruption(fault, corrupt, expected_cls, pattern):
+    def run(h: Harness) -> dict:
+        return h._run_corruption(fault, corrupt, expected_cls, pattern)
+    run.__name__ = f"_fault_{fault.replace('-', '_')}"
+    return run
+
+
+_FAULTS = {
+    "kill-mid-save": _fault_kill_mid_save,
+    "corrupt-leaf-bytes": _mk_corruption(
+        "corrupt-leaf-bytes",
+        lambda root: _flip_byte_mid(
+            os.path.join(_newest_version(root), "params.npz")),
+        DigestMismatch, r"digest mismatch"),
+    "truncated-npz": _mk_corruption(
+        "truncated-npz",
+        lambda root: _truncate_half(
+            os.path.join(_newest_version(root), "params.npz")),
+        TornCheckpoint, r"truncated"),
+    "stale-latest": _mk_corruption(
+        "stale-latest",
+        lambda root: shutil.rmtree(_newest_version(root)),
+        TornCheckpoint, r"LATEST points at missing"),
+    "manifest-digest-drift": _mk_corruption(
+        "manifest-digest-drift",
+        lambda root: _drift_manifest(_newest_version(root)),
+        DigestMismatch, r"digest mismatch"),
+    "missing-opt-state": _mk_corruption(
+        "missing-opt-state",
+        lambda root: os.remove(
+            os.path.join(_newest_version(root), "opt_state.npz")),
+        TornCheckpoint, r"missing \(listed in manifest\)"),
+    "config-mismatch": _fault_config_mismatch,
+    "nan-grad-at-step-k": _fault_nan_grad,
+}
+
+
+def _drift_manifest(vdir: str) -> None:
+    path = os.path.join(vdir, "manifest.json")
+    with open(path) as f:
+        man = json.load(f)
+    digest = man["files"]["params.npz"]["blake2b"]
+    man["files"]["params.npz"]["blake2b"] = (
+        ("0" if digest[0] != "0" else "1") + digest[1:])
+    with open(path, "w") as f:
+        json.dump(man, f)
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def _fmt_report(rows: list[dict]) -> str:
+    lines = [
+        f"trainsan: checkpoint/blow-up chaos harness "
+        f"(mode={rows[0]['mode']}, seed={rows[0]['seed']}, "
+        f"{STEPS} steps, checkpoints every {CKPT_EVERY})",
+        f"  {'fault':<22} {'expected':<34} {'detected':<9} "
+        f"{'recovered':<10} verdict",
+    ]
+    for r in rows:
+        verdict = ("ok" if r["ok"]
+                   else "FALSE POSITIVE" if r["fault"] == "clean"
+                   else "MISSED" if not r["detected"]
+                   else "NOT BIT-EXACT")
+        lines.append(
+            f"  {r['fault']:<22} {'|'.join(r['expected']):<34} "
+            f"{str(r['detected']):<9} {str(r['recovered']):<10} {verdict}")
+    n_bad = sum(1 for r in rows if not r["ok"])
+    lines.append("  all detected, recovery bit-exact, clean run clean"
+                 if n_bad == 0 else f"  {n_bad} verdict(s) FAILED")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trainsan",
+        description="training-plane chaos harness: inject checkpoint and "
+                    "blow-up faults, prove typed detection + bit-exact "
+                    "recovery")
+    ap.add_argument("--fault", help="single fault to inject (see --list); "
+                                    "default: every fault + the clean run")
+    ap.add_argument("--mode", default="single",
+                    choices=tuple(MODE_ARGS),
+                    help="parallel mode for the training runs "
+                         "(default single device)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="run seed (params init + step-keyed data stream)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list fault classes, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        if args.json:
+            print(json.dumps({"faults": fault_names(),
+                              "modes": list(MODE_ARGS)}))
+        else:
+            print("fault classes (--fault):")
+            for name in fault_names():
+                print(f"  {name}")
+            print(f"modes (--mode): {' '.join(MODE_ARGS)}")
+        return 0
+
+    try:
+        with Harness(args.mode, args.seed) as h:
+            if args.fault:
+                rows = [h.run_fault(args.fault)]
+            else:
+                rows = h.run_all()
+    except Exception as e:  # noqa: BLE001 — exit 2 is the build-error gate
+        if args.json:
+            print(json.dumps({"schema": "trainsan/v1",
+                              "error": f"{type(e).__name__}: {e}"}))
+        else:
+            traceback.print_exc()
+            print(f"trainsan: BUILD/RUN ERROR: {type(e).__name__}: {e}")
+        return 2
+
+    print(json.dumps({"schema": "trainsan/v1", "rows": rows})
+          if args.json else _fmt_report(rows))
+    return 0 if all(r["ok"] for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

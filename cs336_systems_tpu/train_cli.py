@@ -41,6 +41,7 @@ Examples::
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 from cs336_systems_tpu.utils.platform import honor_cpu_request
@@ -59,7 +60,24 @@ import functools
 
 from cs336_systems_tpu.optim.adamw import AdamWHparams
 from cs336_systems_tpu.optim.schedule import get_cosine_lr
-from cs336_systems_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from cs336_systems_tpu.utils.checkpoint import (
+    find_latest_intact,
+    load_checkpoint,
+    save_checkpoint,
+)
+from cs336_systems_tpu.utils.errors import CheckpointError
+
+# trainsan's blow-up injection seam (same idiom as checkpoint._FAULT_HOOK
+# and gradsan's mutation seams): when set, called as
+# hook(step_no, state, loss) -> (state, loss) after every optimizer step,
+# BEFORE non-finite detection — a transient-blow-up model the recovery
+# policy must catch and discard. None in production.
+_STEP_FAULT_HOOK = None
+
+# memkit static peak is a pure function of (callable family, shapes);
+# cache it so repeated in-process main() calls (trainsan runs dozens)
+# don't re-lower the step per run.
+_ANALYZED_PEAK_CACHE: dict = {}
 
 
 def _load_corpus(args) -> np.ndarray:
@@ -108,6 +126,37 @@ class _Layer:
         from jax.sharding import NamedSharding
 
         return NamedSharding(self.mesh, self.batch_spec)
+
+
+def _state_to_host(state):
+    """Snapshot a (possibly sharded) training state to host memory.
+
+    Donation invalidates the pre-step device buffers the moment ``run``
+    consumes them, so the blow-up recovery policy (``--skip-nonfinite``)
+    snapshots BEFORE each step and re-places from host when the step must
+    be discarded. Leaves are re-placed with their recorded shardings, so
+    the round trip is bit-exact in every parallel mode; non-``jax.Array``
+    leaves pass through untouched (re-wrapping a python scalar would
+    change the step's arg signature and force a recompile). Pure host-side
+    bookkeeping — the compiled step program is never touched.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    snap = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            snap.append((np.asarray(leaf), leaf.sharding))
+        else:
+            snap.append((leaf, None))
+    return treedef, snap
+
+
+def _state_from_host(snapshot):
+    treedef, snap = snapshot
+    leaves = [
+        jax.device_put(host, sh) if sh is not None else host
+        for host, sh in snap
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _require_opt(ck):
@@ -456,8 +505,29 @@ def main(argv=None) -> None:
     p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--keep", type=int, default=3,
+                   help="checkpoint retention ring: keep the newest N "
+                        "step-versioned checkpoints (0 = keep all)")
     p.add_argument("--resume", action="store_true",
-                   help="resume params/opt/step from --checkpoint-dir")
+                   help="resume params/opt/step from --checkpoint-dir "
+                        "(verified; a torn/corrupt newest version falls "
+                        "back to the newest intact one)")
+    p.add_argument("--skip-nonfinite", action="store_true",
+                   help="blow-up recovery: on a non-finite loss/grad step, "
+                        "discard the update (host snapshot restored) and "
+                        "advance the step-keyed data stream — the schedule "
+                        "and batch sequence stay aligned with an "
+                        "uninterrupted run. Host-side only: the compiled "
+                        "step program is identical with this on or off. "
+                        "Forces one dispatch per step (a measurement-grade "
+                        "fence, like --telemetry)")
+    p.add_argument("--rollback-after", type=int, default=0, metavar="K",
+                   help="after K consecutive non-finite steps, roll back "
+                        "to the newest intact checkpoint and replay "
+                        "(implies --skip-nonfinite; requires "
+                        "--checkpoint-dir). Deterministic replay: the "
+                        "step-keyed stream re-draws exactly the batches "
+                        "the uninterrupted run would have drawn")
     p.add_argument("--window", type=int, default=None,
                    help="causal sliding-window attention width in tokens "
                         "(banded Pallas grids: cost scales with the window, "
@@ -468,6 +538,16 @@ def main(argv=None) -> None:
                         "required for long contexts (ctx-65536 on one v5e "
                         "demands ~25 GB of stashes without it)")
     args = p.parse_args(argv)
+
+    if args.rollback_after < 0:
+        raise SystemExit("--rollback-after must be >= 0")
+    if args.rollback_after:
+        args.skip_nonfinite = True
+        if not args.checkpoint_dir:
+            raise SystemExit(
+                "--rollback-after requires --checkpoint-dir (it rolls back "
+                "to the newest intact checkpoint)"
+            )
 
     on_tpu = jax.default_backend() == "tpu"
     overrides = {
@@ -548,6 +628,8 @@ def main(argv=None) -> None:
         loop_chunk = 1  # in-jit loop is wired for the single-device path
     if args.telemetry:
         loop_chunk = 1  # per-step lines need one dispatch per step
+    if args.skip_nonfinite:
+        loop_chunk = 1  # recovery fences every step's loss to host
 
     # Donation is safe with checkpointing: save_checkpoint pulls the state
     # to host before the next run() call consumes the donated buffers.
@@ -585,7 +667,18 @@ def main(argv=None) -> None:
     if args.resume:
         if not args.checkpoint_dir:
             raise SystemExit("--resume requires --checkpoint-dir")
-        ck = load_checkpoint(args.checkpoint_dir)
+        try:
+            ck = load_checkpoint(args.checkpoint_dir, expect_config=cfg)
+        except CheckpointError as e:
+            if not e.retriable:
+                # ConfigMismatch / NoIntactCheckpoint: walking back cannot
+                # help (utils/errors.py) — surface the typed verdict
+                raise SystemExit(f"{type(e).__name__}: {e}")
+            print(f"WARNING: {type(e).__name__}: {e} — falling back to "
+                  f"the newest intact checkpoint")
+            path, _ = find_latest_intact(
+                args.checkpoint_dir, expect_config=cfg)
+            ck = load_checkpoint(path, expect_config=cfg)
         # every mode restores exactly — the sharded ones re-place their
         # [world, chunk] state onto the mesh (re-chunked if the device
         # count changed; parallel.zero.rechunk_rows)
@@ -647,9 +740,14 @@ def main(argv=None) -> None:
         # every mode can --resume exactly
         save_checkpoint(
             args.checkpoint_dir, to_params(state), config=cfg,
-            opt_state=layer.to_opt(state), step=step_no,
+            opt_state=layer.to_opt(state), step=step_no, keep=args.keep,
         )
         print(f"checkpointed step {step_no} -> {args.checkpoint_dir}")
+
+    if args.rollback_after and not args.resume:
+        # rollback floor: guarantee find_latest_intact has an intact
+        # version even when the blow-up precedes the first cadence save
+        save(start_step)
 
     tele = None
     if args.telemetry:
@@ -664,20 +762,27 @@ def main(argv=None) -> None:
         # additive telemetry, never fatal: a mode memkit can't analyze
         # just writes null
         analyzed_peak = None
+        _peak_key = (args.parallel, args.batch, args.ctx, repr(cfg))
         try:
-            from cs336_systems_tpu.analysis import memkit
+            if _peak_key in _ANALYZED_PEAK_CACHE:
+                analyzed_peak = _ANALYZED_PEAK_CACHE[_peak_key]
+            else:
+                from cs336_systems_tpu.analysis import memkit
 
-            _fn = run_metrics if run_metrics is not None else run
-            state_abs = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype, sharding=getattr(a, "sharding", None)),
-                state,
-            )
-            batch_abs = jax.ShapeDtypeStruct((args.batch, args.ctx), "int32")
-            analyzed_peak = memkit.profile_callable(
-                _fn, (state_abs, batch_abs, batch_abs),
-                family=f"train_cli_{args.parallel}",
-            )["peak_bytes"]
+                _fn = run_metrics if run_metrics is not None else run
+                state_abs = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype,
+                        sharding=getattr(a, "sharding", None)),
+                    state,
+                )
+                batch_abs = jax.ShapeDtypeStruct(
+                    (args.batch, args.ctx), "int32")
+                analyzed_peak = memkit.profile_callable(
+                    _fn, (state_abs, batch_abs, batch_abs),
+                    family=f"train_cli_{args.parallel}",
+                )["peak_bytes"]
+                _ANALYZED_PEAK_CACHE[_peak_key] = analyzed_peak
         except Exception:  # noqa: BLE001 — telemetry is additive
             pass
 
@@ -692,10 +797,18 @@ def main(argv=None) -> None:
             except Exception:  # noqa: BLE001 — internal API, may move
                 return None
 
-    # non-finite sentinel (ISSUE 10): cumulative counts of steps whose
-    # loss / grad_norm came back NaN or inf — detection only (the run is
-    # NOT stopped; a blow-up's onset step is what the JSONL is for)
+    # non-finite sentinel (ISSUE 10) + blow-up recovery (ISSUE 11): the
+    # counters stay cumulative detection; with --skip-nonfinite a poisoned
+    # update is DISCARDED (pre-step host snapshot restored) and the
+    # step-keyed stream advances, and with --rollback-after K a run of K
+    # consecutive bad steps rolls back to the newest intact checkpoint and
+    # replays deterministically. All of it is host-side bookkeeping — the
+    # compiled step program is byte-identical with recovery on or off.
     nonfinite_loss = nonfinite_grad = 0
+    skipped_steps = rollbacks = 0
+    consecutive_bad = 0
+    onset_step = None
+    recover = args.skip_nonfinite
 
     t0 = time.perf_counter()
     tokens_done = 0
@@ -703,6 +816,9 @@ def main(argv=None) -> None:
     while step_i < args.steps:
         gnorm = None
         chunk = min(loop_chunk, args.steps - step_i)
+        # recovery snapshots the PRE-step state: donation has invalidated
+        # the device buffers by the time a bad loss is observable
+        snap = _state_to_host(state) if recover else None
         if chunk == loop_chunk and loop_chunk > 1:
             # step-keyed stream: the chunk's key depends only on
             # (seed, step_i), so resume == uninterrupted (see above)
@@ -721,10 +837,14 @@ def main(argv=None) -> None:
                 step_fn = run_one if (loop_chunk > 1 and run_one) else run
                 state, loss = step_fn(state, x, y)
             chunk = 1
+        if _STEP_FAULT_HOOK is not None:  # trainsan seam — see module top
+            state, loss = _STEP_FAULT_HOOK(step_i + chunk, state, loss)
         prev = step_i
-        step_i += chunk
+        done = step_i + chunk  # the step number just attempted
+        step_i = done
         tokens_done += args.batch * args.ctx * chunk
-        if tele is not None:
+        loss_val = gnorm_val = None
+        if recover or tele is not None:
             # float(loss) is the hard device fence: wall below reflects
             # COMPLETED work, not the async dispatch queue (CLAUDE.md)
             loss_val = float(loss)
@@ -732,20 +852,64 @@ def main(argv=None) -> None:
             if not np.isfinite(loss_val):
                 nonfinite_loss += 1
                 if nonfinite_loss == 1:
+                    what = ("update skipped" if recover
+                            else "training continues")
                     print(f"WARNING: non-finite loss ({loss_val}) first "
-                          f"seen at step {step_i} — training continues; "
-                          f"see the telemetry JSONL's nonfinite_loss "
-                          f"column for the onset")
+                          f"seen at step {done} — {what}; see the "
+                          f"telemetry JSONL's nonfinite_loss column for "
+                          f"the onset")
             if gnorm_val is not None and not np.isfinite(gnorm_val):
                 nonfinite_grad += 1
                 if nonfinite_grad == 1:
+                    what = ("update skipped" if recover
+                            else "training continues")
                     print(f"WARNING: non-finite grad_norm ({gnorm_val}) "
-                          f"first seen at step {step_i} — training "
-                          f"continues; see the telemetry JSONL's "
-                          f"nonfinite_grad column for the onset")
+                          f"first seen at step {done} — {what}; see the "
+                          f"telemetry JSONL's nonfinite_grad column for "
+                          f"the onset")
+        bad = rolled_back = False
+        rollback_to = None
+        if recover:
+            bad = (not np.isfinite(loss_val)) or (
+                gnorm_val is not None and not np.isfinite(gnorm_val))
+            if bad:
+                if onset_step is None:
+                    onset_step = done
+                skipped_steps += 1
+                consecutive_bad += 1
+                state = _state_from_host(snap)
+                print(f"RECOVERY: step {done} non-finite — update "
+                      f"discarded, step-keyed stream advances")
+                if args.rollback_after and \
+                        consecutive_bad >= args.rollback_after:
+                    if rollbacks >= 8:
+                        # replay is deterministic: if 8 rollbacks all
+                        # re-diverged, this blow-up is data/state-driven
+                        # and replaying cannot help
+                        raise SystemExit(
+                            "RECOVERY: giving up after 8 rollbacks — the "
+                            "non-finite step reproduces under replay "
+                            "(deterministic blow-up; lower the lr or "
+                            "inspect the data)"
+                        )
+                    path, ck_step = find_latest_intact(
+                        args.checkpoint_dir, expect_config=cfg)
+                    ck = load_checkpoint(path, expect_config=cfg)
+                    state = layer.restore(ck)
+                    rollback_to = ck_step or 0
+                    rollbacks += 1
+                    consecutive_bad = 0
+                    rolled_back = True
+                    print(f"RECOVERY: {args.rollback_after} consecutive "
+                          f"non-finite steps — rolled back to intact "
+                          f"checkpoint step {rollback_to} ({path}), "
+                          f"replaying")
+            else:
+                consecutive_bad = 0
+        if tele is not None:
             wall = time.perf_counter() - t0
             tele.write(json.dumps({
-                "step": step_i,
+                "step": done,
                 "loss": round(loss_val, 6),
                 "grad_norm": (round(gnorm_val, 6)
                               if gnorm_val is not None else None),
@@ -755,32 +919,43 @@ def main(argv=None) -> None:
                 "recompile_count": _recompile_count(),
                 "nonfinite_loss": nonfinite_loss,
                 "nonfinite_grad": nonfinite_grad,
+                "skipped_steps": skipped_steps,
+                "rollbacks": rollbacks,
+                "nonfinite_onset_step": onset_step,
                 "wall_s": round(wall, 3),
             }) + "\n")
+            # flush + fsync per line: a killed run keeps its tail — the
+            # blow-up onset and last live step are exactly what
+            # post-mortem needs (ISSUE 11)
             tele.flush()
+            os.fsync(tele.fileno())
         if args.log_every and (
-            step_i % args.log_every == 0
-            or step_i >= args.steps
-            or prev // args.log_every != step_i // args.log_every
+            done % args.log_every == 0
+            or done >= args.steps
+            or prev // args.log_every != done // args.log_every
         ):
-            loss_val = float(loss)  # hard device fence BEFORE reading the clock
+            if loss_val is None:
+                loss_val = float(loss)  # device fence BEFORE the clock
             dt = time.perf_counter() - t0
             print(
-                f"step {step_i:6d}  loss {loss_val:7.4f}  "
+                f"step {done:6d}  loss {loss_val:7.4f}  "
                 f"{tokens_done / dt:9.0f} tok/s"
             )
-        if eval_fn is not None and (
-            prev // args.eval_every != step_i // args.eval_every
-            or step_i >= args.steps
+        if eval_fn is not None and not bad and (
+            prev // args.eval_every != done // args.eval_every
+            or done >= args.steps
         ):
-            print(f"step {step_i:6d}  eval_loss {eval_fn(state):7.4f}")
+            print(f"step {done:6d}  eval_loss {eval_fn(state):7.4f}")
         if (
-            args.checkpoint_dir
+            not bad
+            and args.checkpoint_dir
             and args.checkpoint_every
-            and prev // args.checkpoint_every != step_i // args.checkpoint_every
+            and prev // args.checkpoint_every != done // args.checkpoint_every
         ):
-            save(step_i)
-            step_saved = step_i
+            save(done)
+            step_saved = done
+        if rolled_back:
+            step_i = rollback_to
     if args.checkpoint_dir and step_saved != step_i:
         save(step_i)
     if tele is not None:

@@ -1,3 +1,25 @@
-from cs336_systems_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+from cs336_systems_tpu.utils.checkpoint import (
+    find_latest_intact,
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from cs336_systems_tpu.utils.errors import (
+    CheckpointError,
+    ConfigMismatch,
+    DigestMismatch,
+    NoIntactCheckpoint,
+    TornCheckpoint,
+)
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "find_latest_intact",
+    "verify_checkpoint",
+    "CheckpointError",
+    "TornCheckpoint",
+    "DigestMismatch",
+    "ConfigMismatch",
+    "NoIntactCheckpoint",
+]

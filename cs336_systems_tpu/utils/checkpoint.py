@@ -1,23 +1,82 @@
-"""Pytree checkpointing: model config + params (+ optimizer state).
+"""Atomic, verified, versioned pytree checkpointing (ISSUE 11 tentpole).
 
 Meets and exceeds the reference's checkpoint surface
 (``BasicsTransformerLM.from_pretrained``, model.py:312-327: a config json +
-weight file): we additionally checkpoint optimizer state, enabling true
-resume-mid-run, which the reference lacks (SURVEY §5).
+weight file): we additionally checkpoint optimizer state (true
+resume-mid-run, SURVEY §5) and — because production TPU training is
+preemption-driven by design — make every save crash-atomic and every load
+verified.
 
-Format: ``model_config.json`` + flat ``.npz`` files whose keys are
-``/``-joined pytree paths — readable with plain numpy, no pickle, portable
-across hosts and jax versions.
+Store layout (format 2)::
+
+    <dir>/
+      step-00000004/            one immutable version per saved step
+        model_config.json
+        params.npz              flat ``/``-joined pytree paths, plain numpy
+        opt_state.npz
+        step.json
+        manifest.json           written LAST: format version, step, config
+                                blake2b, per-file {blake2b, bytes}
+      step-00000006/
+      LATEST                    text pointer to the newest version, updated
+                                last via write-tmp + os.replace
+
+Durability protocol: a save writes every file into a ``.tmp-*`` sibling
+(fsync each), writes ``manifest.json`` last, fsyncs the dir, then publishes
+with ONE ``os.rename`` and only afterwards flips ``LATEST``. A kill between
+any two writes therefore leaves either (a) an ignorable torn temp dir — it
+has no manifest, so it can never verify — or (b) a fully published version
+that ``LATEST`` does not point at yet, which ``find_latest_intact`` still
+finds by scanning version dirs newest-first. Verification failures raise
+the typed errors in ``utils/errors.py`` (``TornCheckpoint`` for
+missing/truncated structure, ``DigestMismatch`` for content drift,
+``ConfigMismatch`` for resuming with the wrong model); callers branch on
+``err.retriable`` and walk back via ``find_latest_intact``.
+
+Old-format directories (``params.npz`` at top level, no ``manifest.json``)
+still load through a compat shim — unverified, as before.
+
+Single-writer assumption: one training process owns a checkpoint dir (the
+repo's one-chip-process-at-a-time rule); the protocol defends against
+kills, not concurrent savers.
+
+``_FAULT_HOOK`` is trainsan's kill-mid-save injection seam (same idiom as
+gradsan's mutation seams): when set, it is called with an event string at
+every durability boundary — ``begin:<version>``, ``file:<name>`` after
+each file write, ``published`` after the rename, ``latest`` after the
+pointer flip — and raising from it aborts the save at exactly that point.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any
+import shutil
+from typing import Any, Callable
 
 import jax
 import numpy as np
+
+from cs336_systems_tpu.utils.errors import (
+    CheckpointError,
+    ConfigMismatch,
+    DigestMismatch,
+    NoIntactCheckpoint,
+    TornCheckpoint,
+)
+
+FORMAT_VERSION = 2
+LATEST = "LATEST"
+_STEP_FMT = "step-{:08d}"
+
+# trainsan seam — see module docstring. None in production.
+_FAULT_HOOK: Callable[[str], None] | None = None
+
+
+def _hook(event: str) -> None:
+    if _FAULT_HOOK is not None:
+        _FAULT_HOOK(event)
 
 
 def _flatten(tree) -> dict[str, np.ndarray]:
@@ -41,35 +100,314 @@ def _unflatten(flat: dict[str, np.ndarray]):
     return tree
 
 
-def save_checkpoint(directory: str, params, config=None, opt_state=None, step: int | None = None):
+def _config_dict(config) -> dict:
+    return config.to_dict() if hasattr(config, "to_dict") else dict(config)
+
+
+def _config_digest(cfg: dict) -> str:
+    blob = json.dumps(cfg, sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_json(directory: str, name: str, obj) -> None:
+    path = os.path.join(directory, name)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    _hook(f"file:{name}")
+
+
+def _write_npz(directory: str, name: str, flat: dict[str, np.ndarray]) -> None:
+    path = os.path.join(directory, name)
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+        f.flush()
+        os.fsync(f.fileno())
+    _hook(f"file:{name}")
+
+
+def _version_dirs(directory: str) -> list[tuple[int, str]]:
+    """Published version dirs as (step, name), ascending by step."""
+    out = []
+    for name in os.listdir(directory):
+        if not name.startswith("step-"):
+            continue
+        if not os.path.isdir(os.path.join(directory, name)):
+            continue
+        try:
+            out.append((int(name[len("step-"):]), name))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def _is_old_format(directory: str) -> bool:
+    return os.path.isfile(
+        os.path.join(directory, "params.npz")
+    ) and not os.path.isfile(os.path.join(directory, "manifest.json"))
+
+
+def save_checkpoint(
+    directory: str,
+    params,
+    config=None,
+    opt_state=None,
+    step: int | None = None,
+    keep: int | None = None,
+):
+    """Atomically publish one immutable ``step-XXXXXXXX`` version under
+    ``directory`` (see module docstring for the durability protocol).
+
+    ``keep``: retention ring — after publishing, prune all but the newest
+    ``keep`` versions (None or <= 0 keeps everything). Returns the path of
+    the published version dir.
+    """
     os.makedirs(directory, exist_ok=True)
-    if config is not None:
-        cfg = config.to_dict() if hasattr(config, "to_dict") else dict(config)
-        with open(os.path.join(directory, "model_config.json"), "w") as f:
-            json.dump(cfg, f, indent=2)
-    np.savez(os.path.join(directory, "params.npz"), **_flatten(params))
-    if opt_state is not None:
-        np.savez(os.path.join(directory, "opt_state.npz"), **_flatten(opt_state))
-    if step is not None:
-        with open(os.path.join(directory, "step.json"), "w") as f:
-            json.dump({"step": int(step)}, f)
+    step_no = int(step) if step is not None else 0
+    name = _STEP_FMT.format(step_no)
+    tmp = os.path.join(directory, f".tmp-{name}-{os.getpid()}")
+    # Sweep debris from earlier killed saves — torn temps never verify, but
+    # there is no reason to let them accumulate.
+    for entry in os.listdir(directory):
+        full = os.path.join(directory, entry)
+        if entry.startswith((".tmp-", ".trash-")) and full != tmp:
+            shutil.rmtree(full, ignore_errors=True)
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    _hook(f"begin:{name}")
+    try:
+        written: list[str] = []
+        cfg = None
+        if config is not None:
+            cfg = _config_dict(config)
+            _write_json(tmp, "model_config.json", cfg)
+            written.append("model_config.json")
+        _write_npz(tmp, "params.npz", _flatten(params))
+        written.append("params.npz")
+        if opt_state is not None:
+            _write_npz(tmp, "opt_state.npz", _flatten(opt_state))
+            written.append("opt_state.npz")
+        if step is not None:
+            _write_json(tmp, "step.json", {"step": step_no})
+            written.append("step.json")
+        manifest = {
+            "format": FORMAT_VERSION,
+            "step": step_no if step is not None else None,
+            "config_blake2b": _config_digest(cfg) if cfg is not None else None,
+            "files": {
+                fname: {
+                    "blake2b": _file_digest(os.path.join(tmp, fname)),
+                    "bytes": os.path.getsize(os.path.join(tmp, fname)),
+                }
+                for fname in written
+            },
+        }
+        _write_json(tmp, "manifest.json", manifest)
+        _fsync_dir(tmp)
+    except BaseException:
+        # A crash mid-save (incl. an injected kill) must leave the temp dir
+        # behind exactly as the real preemption would — no cleanup here.
+        raise
+    final = os.path.join(directory, name)
+    if os.path.exists(final):
+        # Re-save of the same step (e.g. replay after rollback): move the
+        # old version aside first — a kill between the two renames costs at
+        # worst this one step, never an older version.
+        os.rename(final, os.path.join(directory, f".trash-{name}-{os.getpid()}"))
+    os.rename(tmp, final)
+    _fsync_dir(directory)
+    _hook("published")
+    # LATEST flips last, atomically, so it never points at a torn dir.
+    latest_tmp = os.path.join(directory, f".{LATEST}.tmp-{os.getpid()}")
+    with open(latest_tmp, "w") as f:
+        f.write(name + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(latest_tmp, os.path.join(directory, LATEST))
+    _fsync_dir(directory)
+    _hook("latest")
+    for entry in os.listdir(directory):
+        if entry.startswith(".trash-"):
+            shutil.rmtree(os.path.join(directory, entry), ignore_errors=True)
+    if keep is not None and keep > 0:
+        versions = _version_dirs(directory)
+        for _, old in versions[:-keep]:
+            if old != name:
+                shutil.rmtree(os.path.join(directory, old), ignore_errors=True)
+    return final
 
 
-def load_checkpoint(directory: str):
-    """Returns dict with keys: params, config (dict|None), opt_state (|None), step (|None)."""
+def read_manifest(version_dir: str) -> dict:
+    """Parse a version dir's manifest; typed ``TornCheckpoint`` when the
+    save died before the manifest (its final write) landed."""
+    path = os.path.join(version_dir, "manifest.json")
+    if not os.path.isfile(path):
+        raise TornCheckpoint(
+            "manifest.json missing (save was interrupted before its final "
+            "write — this version never became durable)",
+            path=version_dir,
+        )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise TornCheckpoint(f"manifest.json unreadable: {e}", path=version_dir)
+
+
+def verify_checkpoint(version_dir: str, expect_config=None) -> dict:
+    """Verify one version dir against its manifest. Returns the manifest;
+    raises ``TornCheckpoint`` (missing/truncated file, bad manifest),
+    ``DigestMismatch`` (content drift), or ``ConfigMismatch``."""
+    man = read_manifest(version_dir)
+    if man.get("format") != FORMAT_VERSION:
+        raise TornCheckpoint(
+            f"unsupported manifest format {man.get('format')!r} "
+            f"(this build reads format {FORMAT_VERSION})",
+            path=version_dir,
+        )
+    for fname, rec in man.get("files", {}).items():
+        fpath = os.path.join(version_dir, fname)
+        if not os.path.isfile(fpath):
+            raise TornCheckpoint(
+                f"{fname} missing (listed in manifest)", path=version_dir
+            )
+        size = os.path.getsize(fpath)
+        if size != rec["bytes"]:
+            raise TornCheckpoint(
+                f"{fname} truncated: {size} bytes on disk, "
+                f"{rec['bytes']} in manifest",
+                path=version_dir,
+            )
+        if _file_digest(fpath) != rec["blake2b"]:
+            raise DigestMismatch(
+                f"{fname} digest mismatch vs manifest (content corrupted "
+                "after publish)",
+                path=version_dir,
+            )
+    if expect_config is not None and man.get("config_blake2b") is not None:
+        want = _config_digest(_config_dict(expect_config))
+        if want != man["config_blake2b"]:
+            raise ConfigMismatch(
+                "checkpoint was written for a different model config "
+                f"(manifest {man['config_blake2b'][:12]}…, "
+                f"caller {want[:12]}…)",
+                path=version_dir,
+            )
+    return man
+
+
+def _resolve(directory: str) -> str:
+    """Map a load target onto the version dir to read.
+
+    Accepts: a version dir itself (has a manifest), an old-format dir
+    (compat shim), or a store root — where ``LATEST`` wins, falling back
+    to the newest published version when the pointer is missing (the
+    legal kill-window between publish and pointer flip)."""
+    if os.path.basename(os.path.abspath(directory)).startswith(".tmp-"):
+        # an in-flight save dir: pre-manifest it would otherwise pass for
+        # an old-format checkpoint and load unverified
+        raise TornCheckpoint(
+            "unpublished .tmp save dir (the save was interrupted before "
+            "publish; this version never became durable)",
+            path=directory,
+        )
+    if os.path.isfile(os.path.join(directory, "manifest.json")):
+        return directory
+    if _is_old_format(directory):
+        return directory
+    latest = os.path.join(directory, LATEST)
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            name = f.read().strip()
+        target = os.path.join(directory, name)
+        if not os.path.isdir(target):
+            raise TornCheckpoint(
+                f"LATEST points at missing version {name!r}", path=directory
+            )
+        return target
+    versions = _version_dirs(directory)
+    if versions:
+        return os.path.join(directory, versions[-1][1])
+    raise NoIntactCheckpoint("no checkpoint found", path=directory)
+
+
+def _load_version(version_dir: str) -> dict:
     out: dict[str, Any] = {"config": None, "opt_state": None, "step": None}
-    cfg_path = os.path.join(directory, "model_config.json")
+    cfg_path = os.path.join(version_dir, "model_config.json")
     if os.path.exists(cfg_path):
         with open(cfg_path) as f:
             out["config"] = json.load(f)
-    with np.load(os.path.join(directory, "params.npz")) as z:
+    with np.load(os.path.join(version_dir, "params.npz")) as z:
         out["params"] = _unflatten({k: z[k] for k in z.files})
-    opt_path = os.path.join(directory, "opt_state.npz")
+    opt_path = os.path.join(version_dir, "opt_state.npz")
     if os.path.exists(opt_path):
         with np.load(opt_path) as z:
             out["opt_state"] = _unflatten({k: z[k] for k in z.files})
-    step_path = os.path.join(directory, "step.json")
+    step_path = os.path.join(version_dir, "step.json")
     if os.path.exists(step_path):
         with open(step_path) as f:
             out["step"] = json.load(f)["step"]
     return out
+
+
+def load_checkpoint(directory: str, expect_config=None):
+    """Load and VERIFY a checkpoint. ``directory`` may be a store root, a
+    specific version dir, or an old-format dir (compat: loaded unverified).
+
+    Returns dict with keys: params, config (dict|None), opt_state (|None),
+    step (|None). Raises the typed errors from ``utils/errors.py`` on
+    damage; callers wanting automatic walk-back catch ``CheckpointError``
+    where ``retriable`` and retry via ``find_latest_intact``."""
+    vdir = _resolve(directory)
+    if os.path.isfile(os.path.join(vdir, "manifest.json")):
+        verify_checkpoint(vdir, expect_config=expect_config)
+    elif not os.path.isfile(os.path.join(vdir, "params.npz")):
+        raise TornCheckpoint("params.npz missing", path=vdir)
+    return _load_version(vdir)
+
+
+def find_latest_intact(
+    directory: str, expect_config=None
+) -> tuple[str, int | None]:
+    """Walk version dirs newest-first and return ``(path, step)`` of the
+    first one that passes full verification — the recovery entry point
+    after a ``retriable`` load failure. Old-format dirs count as intact
+    (nothing to verify against). Raises ``NoIntactCheckpoint`` when the
+    walk exhausts."""
+    if _is_old_format(directory):
+        step = None
+        step_path = os.path.join(directory, "step.json")
+        if os.path.isfile(step_path):
+            with open(step_path) as f:
+                step = json.load(f)["step"]
+        return directory, step
+    if not os.path.isdir(directory):
+        raise NoIntactCheckpoint("checkpoint directory missing", path=directory)
+    for step_no, name in reversed(_version_dirs(directory)):
+        vdir = os.path.join(directory, name)
+        try:
+            man = verify_checkpoint(vdir, expect_config=expect_config)
+        except CheckpointError:
+            continue
+        return vdir, man.get("step", step_no)
+    raise NoIntactCheckpoint(
+        "no version passes verification", path=directory
+    )

@@ -1,0 +1,91 @@
+"""Typed failure surface of the checkpoint store (ISSUE 11).
+
+The training-plane twin of ``serving/errors.py``: before this module a
+torn or corrupt checkpoint surfaced as whatever ``np.load``/``json``
+happened to throw (``zipfile.BadZipFile``, ``KeyError``,
+``FileNotFoundError``) — a resuming caller could not tell "this one
+version is damaged, walk back to an older intact one" from "resuming
+here would silently train the wrong model". Every class carries
+``retriable`` with the serving convention's semantics, specialized to
+recovery-by-walking-back:
+
+- ``retriable=True``  — the STORE may still hold an intact checkpoint;
+  ``find_latest_intact()`` walks back past the damaged version and a
+  retry against its result is safe (``TornCheckpoint``,
+  ``DigestMismatch``).
+- ``retriable=False`` — walking back cannot help: every version in this
+  directory was written by the same run, so a config that does not
+  match now will not match older versions either
+  (``ConfigMismatch``), and an empty/unrecoverable store has nothing
+  to walk back to (``NoIntactCheckpoint``).
+
+``path``: the checkpoint directory or file the violation was detected
+on, ``None`` when not attributable (mirrors the serving errors'
+``shard``).
+
+Compatibility: each class also subclasses the builtin its call sites
+raised before the typed surface existed (``TornCheckpoint`` /
+``NoIntactCheckpoint`` are ``FileNotFoundError``s — a missing
+``params.npz`` used to surface exactly there — and the content/config
+verification errors are ``ValueError``s), so pre-existing
+``except``/``pytest.raises`` sites keep working while new callers catch
+``CheckpointError`` and branch on ``retriable``.
+
+This module imports nothing from the package (one-way import keeps
+``utils/checkpoint.py`` cycle-free, same rule as ``serving/errors.py``).
+"""
+
+from __future__ import annotations
+
+
+class CheckpointError(Exception):
+    """Base of the checkpoint failure surface. ``retriable`` is a CLASS
+    property of the failure kind — see the module docstring for the
+    walk-back contract."""
+
+    retriable: bool = False
+
+    def __init__(self, detail: str = "", path: str | None = None):
+        self.detail = detail
+        self.path = path
+        super().__init__(
+            detail if path is None else f"{path}: {detail}")
+
+
+class TornCheckpoint(CheckpointError, FileNotFoundError):
+    """The version is structurally incomplete: a manifest-listed file is
+    missing or truncated (size differs from the manifest), the manifest
+    itself is absent/unparseable (a save died before its final write),
+    or the ``LATEST`` pointer names a version that no longer exists.
+    The classic preemption-mid-save signature. Retriable — the atomic
+    publish protocol guarantees an older intact version unless the
+    store is brand new."""
+
+    retriable = True
+
+
+class DigestMismatch(CheckpointError, ValueError):
+    """A file's bytes no longer blake2b-hash to the digest recorded in
+    the manifest (bit rot, a partial overwrite, manual editing). The
+    version's CONTENT cannot be trusted even though its structure looks
+    whole. Retriable — walk back to an older intact version."""
+
+    retriable = True
+
+
+class ConfigMismatch(CheckpointError, ValueError):
+    """The checkpoint was written for a different model config than the
+    caller is resuming with (config blake2b differs). Loading it would
+    silently train the wrong model — and every version in the directory
+    shares the run's config, so walking back cannot help. Not
+    retriable; fix the flags, not the store."""
+
+    retriable = False
+
+
+class NoIntactCheckpoint(CheckpointError, FileNotFoundError):
+    """No version in the directory passes verification (or the
+    directory holds no checkpoint at all). Nothing to walk back to —
+    not retriable."""
+
+    retriable = False

@@ -157,3 +157,218 @@ def test_rechunk_rows_rejects_wrong_model():
     out = rechunk_rows(np.arange(32, dtype=np.float32).reshape(8, 4), 30, 4)
     assert out.shape == (4, 8)
     np.testing.assert_array_equal(out.reshape(-1)[:30], np.arange(30))
+
+
+# -- atomic versioned store + typed verification (ISSUE 11) -------------
+
+import json
+import os
+import shutil
+
+from cs336_systems_tpu.utils.checkpoint import (
+    _FAULT_HOOK,  # noqa: F401 — imported to assert the seam exists
+    find_latest_intact,
+    verify_checkpoint,
+)
+from cs336_systems_tpu.utils import checkpoint as ckpt_mod
+from cs336_systems_tpu.utils.errors import (
+    ConfigMismatch,
+    DigestMismatch,
+    NoIntactCheckpoint,
+    TornCheckpoint,
+)
+
+_P1 = {"w": np.arange(16, dtype=np.float32).reshape(4, 4)}
+_P2 = {"w": np.arange(16, dtype=np.float32).reshape(4, 4) + 1}
+_OPT = {"m": np.zeros((4, 4), np.float32), "t": np.int32(3)}
+
+
+def _newest(root):
+    name = sorted(e for e in os.listdir(root) if e.startswith("step-"))[-1]
+    return os.path.join(root, name)
+
+
+def test_save_publishes_versioned_dir_with_manifest(tmp_path):
+    root = str(tmp_path)
+    final = save_checkpoint(root, _P1, config=CFG, opt_state=_OPT, step=3)
+    assert os.path.basename(final) == "step-00000003"
+    man = verify_checkpoint(final)
+    assert man["step"] == 3
+    assert set(man["files"]) == {
+        "model_config.json", "params.npz", "opt_state.npz", "step.json"}
+    with open(os.path.join(root, "LATEST")) as f:
+        assert f.read().strip() == "step-00000003"
+    assert not [e for e in os.listdir(root) if e.startswith(".tmp-")]
+
+
+def test_stale_sibling_regression(tmp_path):
+    """The pre-ISSUE-11 store wrote files into ONE live dir: a later
+    params-only save left the previous opt_state.npz/step.json behind,
+    so --resume silently paired new params with old optimizer state.
+    Versioned saves make the pairing impossible by construction."""
+    root = str(tmp_path)
+    save_checkpoint(root, _P1, config=CFG, opt_state=_OPT, step=1)
+    save_checkpoint(root, _P2, config=CFG, step=2)  # params-only
+    ck = load_checkpoint(root)
+    np.testing.assert_array_equal(ck["params"]["w"], _P2["w"])
+    assert ck["step"] == 2
+    assert ck["opt_state"] is None  # NOT step 1's stale optimizer rows
+
+
+def test_kill_between_any_two_writes_leaves_intact_store(tmp_path):
+    """Interrupt the step-6 save at EVERY durability boundary: the store
+    must always resolve to a verifiable checkpoint (step 3 before
+    publish, step 6 after), and the torn temp must raise typed."""
+    points = ["file:model_config.json", "file:params.npz",
+              "file:opt_state.npz", "file:step.json", "file:manifest.json",
+              "published", "latest"]
+    for point in points:
+        root = str(tmp_path / point.replace(":", "-"))
+        save_checkpoint(root, _P1, config=CFG, opt_state=_OPT, step=3)
+
+        def hook(event, _point=point):
+            if event == _point:
+                raise RuntimeError(f"injected kill at {_point}")
+
+        ckpt_mod._FAULT_HOOK = hook
+        try:
+            with pytest.raises(RuntimeError, match="injected kill"):
+                save_checkpoint(
+                    root, _P2, config=CFG, opt_state=_OPT, step=6)
+        finally:
+            ckpt_mod._FAULT_HOOK = None
+        want = 3 if point.startswith("file:") else 6
+        path, step = find_latest_intact(root)
+        assert step == want, point
+        ck = load_checkpoint(path)
+        assert ck["step"] == want, point
+        torn = [e for e in os.listdir(root) if e.startswith(".tmp-")]
+        if point.startswith("file:"):
+            assert torn, point
+            with pytest.raises(TornCheckpoint):
+                load_checkpoint(os.path.join(root, torn[0]))
+        # root-level load never sees the torn temp; in the publish→pointer
+        # kill window it follows the stale-but-VALID LATEST (step 3) while
+        # find_latest_intact already sees the published step 6
+        want_root = 3 if point == "published" else want
+        assert load_checkpoint(root)["step"] == want_root, point
+
+
+def test_truncated_and_byteflip_raise_typed_and_fall_back(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _P1, config=CFG, opt_state=_OPT, step=3)
+    save_checkpoint(root, _P2, config=CFG, opt_state=_OPT, step=6)
+
+    # truncate the newest params.npz mid-file -> TornCheckpoint
+    target = os.path.join(_newest(root), "params.npz")
+    keep = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(keep // 2)
+    with pytest.raises(TornCheckpoint, match="truncated"):
+        load_checkpoint(root)
+    path, step = find_latest_intact(root)
+    assert step == 3
+    np.testing.assert_array_equal(
+        load_checkpoint(path)["params"]["w"], _P1["w"])
+
+    # same-size byte flip -> DigestMismatch (content, not structure)
+    save_checkpoint(root, _P2, config=CFG, opt_state=_OPT, step=6)
+    with open(target, "r+b") as f:
+        data = f.read()
+        f.seek(len(data) // 2)
+        f.write(bytes([data[len(data) // 2] ^ 0xFF]))
+    with pytest.raises(DigestMismatch, match="digest mismatch"):
+        load_checkpoint(root)
+    assert find_latest_intact(root)[1] == 3
+
+
+def test_zero1_fallback_restores_on_mesh_after_corruption(tmp_path):
+    """The dp/zero1 side of the satellite: damage the newest version of
+    a real zero1 run's store and prove the typed error + walk-back +
+    [world, chunk] re-placement all compose."""
+    mesh = make_mesh({"dp": 8})
+    step = make_zero1_train_step(CFG, HP, mesh, donate=False)
+    batches = [tuple(shard_batch(mesh, x, y)) for x, y in _batches(4)]
+    params = init_transformer_lm(jax.random.PRNGKey(0), CFG)
+    p, z = params, zero1_init(params, mesh)
+    root = str(tmp_path)
+    for i, (x, y) in enumerate(batches):
+        p, z, _ = step(p, z, x, y)
+        save_checkpoint(root, p, config=CFG, opt_state=z, step=i + 1)
+    # corrupt the newest (step 4): resume must fall back to step 3
+    target = os.path.join(_newest(root), "opt_state.npz")
+    with open(target, "r+b") as f:
+        data = f.read()
+        f.seek(len(data) // 2)
+        f.write(bytes([data[len(data) // 2] ^ 0xFF]))
+    with pytest.raises(DigestMismatch):
+        load_checkpoint(root)
+    path, fb = find_latest_intact(root)
+    assert fb == 3
+    ck = load_checkpoint(path)
+    z2 = zero1_restore(ck["opt_state"], ck["params"], mesh)
+    assert z2["m"].shape[0] == 8  # re-placed [world, chunk] rows
+    p2, z2, _ = step(ck["params"], z2, *batches[3])
+    assert trees_allclose(p2, p, rtol=0, atol=0)  # replay == original
+
+
+def test_config_mismatch_is_typed_and_not_retriable(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _P1, config=CFG, step=1)
+    import dataclasses
+
+    other = dataclasses.replace(CFG, d_model=128)
+    with pytest.raises(ConfigMismatch, match="different model config") as ei:
+        load_checkpoint(root, expect_config=other)
+    assert ei.value.retriable is False
+    # the matching config still loads
+    assert load_checkpoint(root, expect_config=CFG)["step"] == 1
+
+
+def test_retention_ring_prunes_oldest(tmp_path):
+    root = str(tmp_path)
+    for i in range(1, 6):
+        save_checkpoint(root, _P1, config=CFG, step=i, keep=2)
+    steps = sorted(int(e.split("-")[1]) for e in os.listdir(root)
+                   if e.startswith("step-"))
+    assert steps == [4, 5]
+    assert load_checkpoint(root)["step"] == 5
+
+
+def test_old_format_dir_still_loads(tmp_path):
+    """Compat shim: a pre-ISSUE-11 flat checkpoint dir (params.npz at
+    top level, no manifest) loads unverified, and counts as the
+    walk-back floor."""
+    root = str(tmp_path)
+    np.savez(os.path.join(root, "params.npz"),
+             **{"w": _P1["w"]})
+    np.savez(os.path.join(root, "opt_state.npz"),
+             **{"m": _OPT["m"]})
+    with open(os.path.join(root, "step.json"), "w") as f:
+        json.dump({"step": 7}, f)
+    ck = load_checkpoint(root)
+    np.testing.assert_array_equal(ck["params"]["w"], _P1["w"])
+    assert ck["step"] == 7
+    assert find_latest_intact(root)[1] == 7
+
+
+def test_empty_store_raises_no_intact(tmp_path):
+    root = str(tmp_path / "empty")
+    os.makedirs(root)
+    with pytest.raises(NoIntactCheckpoint):
+        load_checkpoint(root)
+    with pytest.raises(NoIntactCheckpoint):
+        find_latest_intact(root)
+
+
+def test_stale_latest_pointer_raises_then_falls_back(tmp_path):
+    root = str(tmp_path)
+    save_checkpoint(root, _P1, config=CFG, step=1)
+    save_checkpoint(root, _P2, config=CFG, step=2)
+    shutil.rmtree(_newest(root))  # LATEST now dangles at step-2
+    with pytest.raises(TornCheckpoint, match="LATEST points at missing"):
+        load_checkpoint(root)
+    path, step = find_latest_intact(root)
+    assert step == 1
+    np.testing.assert_array_equal(
+        load_checkpoint(path)["params"]["w"], _P1["w"])

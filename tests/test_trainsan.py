@@ -1,0 +1,113 @@
+"""trainsan oracle tests: the checkpoint/blow-up chaos harness must
+(a) report the recovery-armed training loop clean when no fault is
+injected (and bit-identical to recovery disabled), and (b) for each
+seeded fault prove the typed detector fires AND the recovered curve is
+bit-exact against the uninterrupted oracle.
+
+Same discipline as tests/test_gradsan.py / the servesan CI gate: the
+harness is itself a test subject — a fault class that stops being
+detected is a MISSED verdict here before it is a gap on chip. The fast
+single-mode cells run in tier 1; the sharded matrix parity cell
+(identical verdicts on zero1's 8-way mesh) is tier-2 ``slow`` — CI's
+package gate runs the full dp/zero1 matrix anyway
+(scripts/run_tests_and_package.sh).
+"""
+
+import json
+
+import pytest
+
+from cs336_systems_tpu.analysis import trainsan
+from cs336_systems_tpu.analysis.trainsan import Harness, fault_names
+
+
+@pytest.fixture(scope="module")
+def harness():
+    """One single-mode cell shared across tests: the oracle run (and its
+    checkpoint store) is cached per Harness, so sharing it keeps the
+    module at one uninterrupted 8-step run plus per-fault resumes."""
+    with Harness("single", seed=0) as h:
+        h.oracle()
+        yield h
+
+
+def test_list_cli_names_every_fault(capsys):
+    assert trainsan.main(["--list", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["faults"] == fault_names()
+    assert set(rep["modes"]) == {"single", "dp", "zero1"}
+    # the contracted 8 fault classes, stable order
+    assert rep["faults"] == [
+        "kill-mid-save", "corrupt-leaf-bytes", "truncated-npz",
+        "stale-latest", "manifest-digest-drift", "missing-opt-state",
+        "config-mismatch", "nan-grad-at-step-k",
+    ]
+
+
+def test_unknown_fault_is_a_build_error(capsys):
+    assert trainsan.main(["--fault", "no-such-fault", "--json"]) == 2
+    rep = json.loads(capsys.readouterr().out)
+    assert "error" in rep and "no-such-fault" in rep["error"]
+
+
+def test_unknown_mode_is_rejected():
+    with pytest.raises(SystemExit):
+        trainsan.main(["--mode", "pp"])  # argparse choices
+
+
+def test_clean_run_zero_findings(harness):
+    row = harness.run_clean()
+    assert row["ok"], row
+    assert row["detail"]["recovery_on_equals_off"]
+    # the oracle never tripped the recovery policy
+    last = harness.oracle()["last"]
+    assert last["skipped_steps"] == 0 and last["rollbacks"] == 0
+    assert last["nonfinite_onset_step"] is None
+
+
+def test_corrupt_leaf_bytes_verdict(harness):
+    row = harness.run_fault("corrupt-leaf-bytes")
+    assert row["ok"], row
+    assert row["detected"] and row["recovered"]
+    assert row["error"]["type"] == "DigestMismatch"
+    assert row["error"]["retriable"] is True
+    # walk-back landed on the newest undamaged version (step 6)
+    assert row["detail"]["fallback_step"] == (
+        trainsan.STEPS - trainsan.CKPT_EVERY)
+
+
+def test_stale_latest_verdict(harness):
+    row = harness.run_fault("stale-latest")
+    assert row["ok"], row
+    assert row["error"]["type"] == "TornCheckpoint"
+    assert row["error"]["retriable"] is True
+
+
+def test_nan_grad_blowup_verdict(harness):
+    row = harness.run_fault("nan-grad-at-step-k")
+    assert row["ok"], row
+    final = row["detail"]["final"]
+    assert final["skipped_steps"] == len(trainsan.NAN_STEPS)
+    assert final["rollbacks"] == 1
+    assert final["nonfinite_onset_step"] == trainsan.NAN_STEPS[0]
+
+
+def test_config_mismatch_verdict(harness):
+    row = harness.run_fault("config-mismatch")
+    assert row["ok"], row
+    assert row["error"]["type"] == "ConfigMismatch"
+    assert row["error"]["retriable"] is False
+    assert row["detail"]["cli_systemexit"]
+
+
+@pytest.mark.slow
+def test_zero1_matrix_parity():
+    """The verdict matrix must not depend on the sharding family: the
+    full zero1 cell (8-way mesh, sharded opt state on disk) returns the
+    same all-ok verdicts as single mode. dp is covered by the CI gate."""
+    with Harness("zero1", seed=0) as h:
+        rows = h.run_all()
+    assert all(r["ok"] for r in rows), [
+        (r["fault"], r["detected"], r["recovered"])
+        for r in rows if not r["ok"]]
+    assert {r["fault"] for r in rows} == set(fault_names()) | {"clean"}
