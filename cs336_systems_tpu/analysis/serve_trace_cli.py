@@ -1,0 +1,142 @@
+"""serve_trace_cli: replay, fold and diff serving-engine flight traces.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m cs336_systems_tpu.analysis.serve_trace_cli \
+        --run --step serve_engine_prefix
+
+``--run`` drives a seeded poisson trace through the named engine family
+(analysis/servetrace.ENGINE_FAMILIES — the same tiny config + dp8 mesh
+as the tracekit/lint registry) on the current backend — the hermetic
+8-virtual-device CPU mesh by default, a real TPU with
+``CS336_TPU_TRACE=1`` — and writes the servetrace/v1 artifact: the
+per-request latency decomposition (queue-wait / prefill-stall / decode /
+host-overhead p50/p99), engine-steps/s with the host-phase breakdown
+(and device ms/step joined from a tracekit StepProfile of the same
+family, unless ``--no-device-join``), and counter windows.
+``--diff a.json b.json`` gates component regressions with the dual
+noise gate (threshold_pct AND abs-floor-ms); ``--report FILE`` renders
+a saved artifact.
+
+Exit status (gradsan shape): 0 ok / diff clean, 1 any delta above
+threshold (CI-gateable) or a failed run, 2 unknown family or build
+error.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (same
+# escape hatch as trace_cli/lint): profiling a real TPU goes through
+# CS336_TPU_TRACE=1, everything else must not grab the tunneled chip.
+if not os.environ.get("CS336_TPU_TRACE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import sys
+
+import jax
+
+if not os.environ.get("CS336_TPU_TRACE"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.analysis.tracekit import write_profile
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cs336_systems_tpu.analysis.serve_trace_cli",
+        description="serving-engine flight-trace replay, folding and "
+                    "diffing (see analysis/README.md)")
+    ap.add_argument("--run", action="store_true",
+                    help="replay a seeded poisson trace through --step's "
+                         "engine family and write the artifact")
+    ap.add_argument("--step", metavar="FAMILY",
+                    help="engine family to replay (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list replayable engine families and exit")
+    ap.add_argument("--requests", type=int, default=12,
+                    help="requests in the replayed trace (default 12)")
+    ap.add_argument("--load", type=float, default=25.0,
+                    help="poisson offered load, requests/s (default 25)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-device-join", action="store_true",
+                    help="skip the tracekit StepProfile join (fast; "
+                         "device_ms_per_step stays null)")
+    ap.add_argument("--out", metavar="PATH",
+                    help="artifact JSON path "
+                         "(default <family>.servetrace.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the artifact/diff JSON instead of the "
+                         "human summary")
+    ap.add_argument("--report", metavar="FILE",
+                    help="render a saved servetrace artifact and exit")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="diff two servetrace artifacts of the same "
+                         "family")
+    ap.add_argument("--threshold", type=float, default=50.0,
+                    help="diff flag threshold in %% (default 50 — host "
+                         "walls jitter far more than device lanes)")
+    ap.add_argument("--abs-floor-ms", type=float, default=2.0,
+                    help="diff flag absolute floor in ms (default 2)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in servetrace.ENGINE_FAMILIES:
+            print(name)
+        return 0
+
+    if args.report:
+        with open(args.report) as f:
+            art = json.load(f)
+        print(json.dumps(art, indent=2) if args.json
+              else servetrace.format_report(art))
+        return 0
+
+    if args.diff:
+        with open(args.diff[0]) as f:
+            a = json.load(f)
+        with open(args.diff[1]) as f:
+            b = json.load(f)
+        try:
+            d = servetrace.diff_servetraces(
+                a, b, threshold_pct=args.threshold,
+                abs_floor_ms=args.abs_floor_ms)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+        print(json.dumps(d, indent=2) if args.json
+              else servetrace.format_diff(d))
+        return 1 if d["n_flagged"] else 0
+
+    if not (args.run and args.step):
+        ap.error("one of --run --step FAMILY, --list, --report or "
+                 "--diff is required")
+    try:
+        art = servetrace.replay(
+            args.step, requests=args.requests, load_rps=args.load,
+            seed=args.seed, device_join=not args.no_device_join)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    except Exception as e:  # build/run error — the gradsan exit-2 class
+        print(f"build error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    out = args.out or f"{args.step}.servetrace.json"
+    write_profile(art, out)
+    if args.json:
+        print(json.dumps(art, indent=2))
+    else:
+        print(servetrace.format_report(art))
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,420 @@
+"""servetrace: fold the serving engine's flight-recorder log into the
+canonical ``servetrace/v1`` artifact (ISSUE 12).
+
+The ROADMAP's disaggregated-prefill and device-resident-control-plane
+items both open with a measurement claim the engine could not produce:
+"a joining request stalls every active decode slot for the full prompt —
+under poisson load that IS the p99" and "at production slot counts the
+host loop, not the chip, sets tokens/s". This module produces those
+numbers from the raw log ``serving/flight.py`` records:
+
+(a) per-request LATENCY DECOMPOSITION — for every completed request,
+
+        e2e = queue_wait + prefill_stall + decode + host_overhead
+
+    exactly (host_overhead is the residual and is asserted >= 0):
+    ``queue_wait`` = decode-ready minus arrival (own prefill included —
+    the request is paying for itself there); ``prefill_stall`` = the
+    summed prefill-batch spans of OTHER requests that landed while this
+    one held a slot (the disaggregated-prefill motivation number);
+    ``decode`` = the step_dispatch + readback_sample phase time of every
+    engine step the request was active in; p50/p99/mean of each
+    component across requests. Non-finite timestamps (the no-clock
+    ``math.inf`` fallback in engine.cancel/evict) SKIP the request and
+    are counted in ``requests.nonfinite_skipped`` — an inf must never
+    poison a percentile.
+
+(b) ENGINE-STEPS/S with the host-phase breakdown (ms/step of the six
+    recorded phases), ``host_overhead_pct`` (schedule_admit +
+    prefix_lookup + table_rewrite over the step wall — the pure
+    host-loop share), and ``device_ms_per_step`` joined from a tracekit
+    StepProfile of the same family when one is supplied — the
+    host-vs-device baseline the device-resident control plane will be
+    judged against.
+
+(c) scheduler/pool/prefix-cache COUNTER WINDOWS: the per-step snapshots
+    averaged over <= 8 windows (occupancy, arrived backlog, free pages,
+    shared pages, cumulative hit/prefill tokens).
+
+``diff_servetraces`` is the CI gate, in diff_profiles' mold: a row flags
+only when BOTH |Δ| > ``abs_floor_ms`` AND |Δ%| > ``threshold_pct`` trip
+(host walls jitter far more than device lanes — the defaults are
+correspondingly looser). ``replay`` drives a seeded poisson trace
+through a real engine per registered family (the CLI's ``--run``).
+
+CLI: ``python -m cs336_systems_tpu.analysis.serve_trace_cli``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+SCHEMA = "servetrace/v1"
+
+COMPONENTS = ("queue_wait", "prefill_stall", "decode", "host_overhead")
+# the pure host-loop phases: what a device-resident control plane would
+# delete (prefill_dispatch is device work; step_dispatch/readback are
+# the dispatch+wait the chip hides at depth > 1)
+HOST_PHASES = ("schedule_admit", "prefix_lookup", "table_rewrite")
+
+# engine families the CLI can replay — the names deliberately match
+# tracekit.FAMILIES so the device join reads the same step program
+ENGINE_FAMILIES: dict[str, dict] = {
+    "serve_engine": {"shared_prefix": 0},
+    "serve_engine_prefix": {"shared_prefix": 16},
+}
+
+_RESIDUAL_TOL = 1e-6  # seconds; clock reads are monotone, ties allowed
+
+
+def _pct(xs: list[float]) -> dict:
+    a = np.asarray(xs, np.float64)
+    return {
+        "p50": round(float(np.percentile(a, 50)) * 1e3, 4),
+        "p99": round(float(np.percentile(a, 99)) * 1e3, 4),
+        "mean": round(float(a.mean()) * 1e3, 4),
+    }
+
+
+def decompose(engine) -> tuple[dict[int, dict], int]:
+    """Per-request component seconds from the engine's flight log.
+
+    Returns ``({rid: {component: s, "e2e": s, "ttft": s|None}},
+    nonfinite_skipped)``. A request decomposes only when its
+    submit→running→finish event chain is complete and every timestamp
+    is finite; the components sum to e2e EXACTLY (host_overhead is the
+    residual, asserted >= -1e-6 then clamped at 0)."""
+    fr = engine.flight
+    by_rid: dict[int, dict[str, dict]] = {}
+    for e in fr.events:
+        slot_events = by_rid.setdefault(e["rid"], {})
+        if e["kind"] not in slot_events:  # first occurrence wins
+            slot_events[e["kind"]] = e
+    step_by_i = {s["i"]: s for s in fr.steps}
+    out: dict[int, dict] = {}
+    skipped = 0
+    for rid, ev in by_rid.items():
+        if not ("submit" in ev and "running" in ev and "finish" in ev):
+            continue
+        arrival = ev["submit"]["t"]
+        t_run, t_fin = ev["running"]["t"], ev["finish"]["t"]
+        ts = [arrival, t_run, t_fin]
+        if "first_token" in ev:
+            ts.append(ev["first_token"]["t"])
+        if not all(math.isfinite(t) for t in ts):
+            skipped += 1
+            continue
+        decode = 0.0
+        for i in range(ev["running"]["step"], ev["finish"]["step"] + 1):
+            s = step_by_i.get(i)
+            if s is not None:
+                decode += (s["phases"]["step_dispatch"]
+                           + s["phases"]["readback_sample"])
+        stall = 0.0
+        for p in fr.prefills:
+            if (rid not in p["rids"]
+                    and math.isfinite(p["t0"]) and math.isfinite(p["t1"])
+                    and t_run <= p["t0"] and p["t1"] <= t_fin):
+                stall += p["t1"] - p["t0"]
+        e2e = t_fin - arrival
+        queue_wait = t_run - arrival
+        host = e2e - queue_wait - stall - decode
+        assert host >= -_RESIDUAL_TOL, (
+            f"rid {rid}: components exceed e2e by {-host:.3e}s — the "
+            f"span accounting is broken")
+        out[rid] = {
+            "queue_wait": queue_wait,
+            "prefill_stall": stall,
+            "decode": decode,
+            "host_overhead": max(host, 0.0),
+            "e2e": e2e,
+            "ttft": (ev["first_token"]["t"] - arrival
+                     if "first_token" in ev else None),
+        }
+    return out, skipped
+
+
+def _windows(steps: list[dict], n: int) -> list[dict]:
+    recs = [s for s in steps if s.get("counters")]
+    if not recs:
+        return []
+    n = max(1, min(n, len(recs)))
+    size = -(-len(recs) // n)
+    out = []
+    for w in range(0, len(recs), size):
+        chunk = recs[w:w + size]
+        keys = chunk[0]["counters"]
+        out.append({
+            "i0": chunk[0]["i"], "i1": chunk[-1]["i"],
+            **{k: round(float(np.mean([c["counters"][k] for c in chunk])),
+                        2) for k in keys},
+        })
+    return out
+
+
+def fold(engine, *, family: str | None = None,
+         device_profile: dict | None = None, windows: int = 8,
+         meta: dict | None = None) -> dict:
+    """Fold a (drained or mid-flight) engine's flight log into the
+    canonical servetrace/v1 dict. ``device_profile``: a tracekit
+    StepProfile of the same family — its total_device_ms_per_step joins
+    in as the host-vs-device split; None leaves the field null."""
+    import jax
+
+    fr = engine.flight
+    per_req, skipped = decompose(engine)
+
+    comps: dict[str, dict] = {}
+    for c in COMPONENTS + ("e2e",):
+        vals = [r[c] for r in per_req.values()]
+        comps[c] = _pct(vals) if vals else None
+    ttfts = [r["ttft"] for r in per_req.values() if r["ttft"] is not None]
+    comps["ttft"] = _pct(ttfts) if ttfts else None
+
+    finite_steps = [s for s in fr.steps
+                    if math.isfinite(s["t0"]) and math.isfinite(s["t1"])]
+    n_steps = len(finite_steps)
+    span = (finite_steps[-1]["t1"] - finite_steps[0]["t0"]
+            if n_steps else 0.0)
+    phase_tot = {p: sum(s["phases"][p] for s in finite_steps)
+                 for p in (finite_steps[0]["phases"] if n_steps else {})}
+    total = sum(phase_tot.values())
+    host = sum(phase_tot.get(p, 0.0) for p in HOST_PHASES)
+    saturated = [s for s in finite_steps
+                 if s.get("counters", {}).get("running") == engine.slots]
+    sat_span = (saturated[-1]["t1"] - saturated[0]["t0"]
+                if len(saturated) > 1 else 0.0)
+
+    emitted = sum(len(s["emits"]) for s in fr.steps)
+    terminal = sum(e.get("tokens", 0) for e in fr.events
+                   if e["kind"] in ("finish", "cancel", "poison"))
+    live = sum(len(r.tokens) for r in engine.running.values())
+
+    kinds = [e["kind"] for e in fr.events]
+    return {
+        "schema": SCHEMA,
+        "family": family,
+        "backend": jax.default_backend(),
+        "slots": engine.slots,
+        "dp": engine.dp,
+        "meta": meta or {},
+        "requests": {
+            "submitted": kinds.count("submit"),
+            "completed": len(engine.results),
+            "shed": kinds.count("shed"),
+            "cancelled": kinds.count("cancel"),
+            "poisoned": kinds.count("poison"),
+            "decomposed": len(per_req),
+            "nonfinite_skipped": skipped,
+        },
+        "components_ms": comps,
+        "steps": {
+            "n": n_steps,
+            "span_s": round(span, 6),
+            "engine_steps_per_s": (round(n_steps / span, 2)
+                                   if span > 0 else None),
+            "n_saturated": len(saturated),
+            "saturated_steps_per_s": (
+                round(len(saturated) / sat_span, 2)
+                if sat_span > 0 else None),
+            "total_ms_per_step": (round(total / n_steps * 1e3, 4)
+                                  if n_steps else 0.0),
+            "phase_ms_per_step": {
+                p: round(v / n_steps * 1e3, 4) if n_steps else 0.0
+                for p, v in phase_tot.items()},
+            "host_ms_per_step": (round(host / n_steps * 1e3, 4)
+                                 if n_steps else 0.0),
+            "host_overhead_pct": (round(host / total * 100.0, 2)
+                                  if total > 0 else 0.0),
+            "device_ms_per_step": (
+                device_profile.get("total_device_ms_per_step")
+                if device_profile else None),
+        },
+        "counters": _windows(finite_steps, windows),
+        "conservation": {
+            "emitted_tokens": emitted,
+            "terminal_tokens": terminal,
+            "live_tokens": live,
+            "ok": emitted == terminal + live,
+        },
+        "nonfinite_spans": fr.nonfinite_spans,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Replay: a seeded poisson trace through a real engine (the CLI's --run)
+
+
+def replay(family: str, *, requests: int = 12, load_rps: float = 25.0,
+           seed: int = 0, device_join: bool = True,
+           iters: int = 2) -> dict:
+    """Build the family's engine on the dp8 mesh (the tracekit/registry
+    geometry's tiny config), drive a seeded poisson trace with the wall
+    clock, and fold. ``device_join`` traces the family's step program
+    through tracekit for the device ms/step column (never fatal — a
+    failed trace leaves the field null and records the error)."""
+    if family not in ENGINE_FAMILIES:
+        raise KeyError(f"unknown engine family {family!r}; known: "
+                       f"{sorted(ENGINE_FAMILIES)}")
+    import time
+
+    import jax
+
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.benchmarks.serving import build_requests
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.serving import ServingEngine
+
+    spec = ENGINE_FAMILIES[family]
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    prompt_len, new_tokens = 6, 6
+    params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
+    reqs = build_requests("uniform", requests, prompt_len, new_tokens,
+                          load_rps, cfg.vocab_size, seed,
+                          shared_prefix=spec["shared_prefix"])
+    t0 = time.monotonic()
+    engine = ServingEngine(
+        params, cfg, key=jax.random.PRNGKey(0), slots=8, n_pages=8,
+        max_blocks=4, page_block=8, temperature=0.9, top_k=8,
+        mesh=mesh, dp_axis="dp",
+        clock=lambda: time.monotonic() - t0)
+    for r in reqs:
+        engine.submit(r)
+    engine.run()
+    engine.check_idle()
+
+    device_profile, join_err = None, None
+    if device_join:
+        try:
+            from cs336_systems_tpu.analysis import tracekit
+
+            device_profile = tracekit.profile_step(family, iters=iters)
+        except Exception as e:  # noqa: BLE001 — the join is best-effort
+            join_err = f"{type(e).__name__}: {e}"
+    art = fold(engine, family=family, device_profile=device_profile,
+               meta={"requests": requests, "load_rps": load_rps,
+                     "seed": seed, "prompt_len": prompt_len,
+                     "new_tokens": new_tokens,
+                     "shared_prefix": spec["shared_prefix"]})
+    if join_err is not None:
+        art["steps"]["device_join_error"] = join_err
+    return art
+
+
+# ---------------------------------------------------------------------------
+# Diffing: the CI gate (diff_profiles' dual noise gate, looser defaults)
+
+
+def _gate_rows(a: dict, b: dict) -> list[tuple[str, str, float, float]]:
+    rows = []
+    ca, cb = a.get("components_ms") or {}, b.get("components_ms") or {}
+    for comp in sorted(set(ca) | set(cb)):
+        for q in ("p50", "p99"):
+            x = (ca.get(comp) or {}).get(q, 0.0) or 0.0
+            y = (cb.get(comp) or {}).get(q, 0.0) or 0.0
+            rows.append(("component", f"{comp}.{q}", x, y))
+    sa, sb = a.get("steps") or {}, b.get("steps") or {}
+    pa = sa.get("phase_ms_per_step") or {}
+    pb = sb.get("phase_ms_per_step") or {}
+    for ph in sorted(set(pa) | set(pb)):
+        rows.append(("phase", ph, pa.get(ph, 0.0), pb.get(ph, 0.0)))
+    for key in ("host_ms_per_step", "total_ms_per_step"):
+        rows.append(("step", key, sa.get(key, 0.0) or 0.0,
+                     sb.get(key, 0.0) or 0.0))
+    return rows
+
+
+def diff_servetraces(a: dict, b: dict, threshold_pct: float = 50.0,
+                     abs_floor_ms: float = 2.0) -> dict:
+    """Component/phase deltas between two servetrace artifacts of the
+    same family. A row FLAGS only when both gates trip: |Δ| >
+    ``abs_floor_ms`` AND |Δ%| > ``threshold_pct`` — host wall times on
+    the CPU mesh jitter tens of percent run to run, hence defaults far
+    looser than tracekit's device-lane gate. Identical artifacts flag
+    nothing."""
+    if a.get("family") != b.get("family"):
+        raise ValueError(
+            f"artifacts are different families: {a.get('family')!r} vs "
+            f"{b.get('family')!r} — deltas would be meaningless")
+    rows = []
+    for kind, key, x, y in _gate_rows(a, b):
+        delta = y - x
+        pct = (delta / x * 100.0) if x else (float("inf") if y else 0.0)
+        rows.append({
+            "kind": kind, "key": key, "a_ms": x, "b_ms": y,
+            "delta_ms": round(delta, 4),
+            "delta_pct": round(pct, 1) if pct != float("inf") else None,
+            "flagged": abs(delta) > abs_floor_ms
+            and (x == 0 or abs(pct) > threshold_pct),
+        })
+    return {
+        "family": a.get("family"),
+        "threshold_pct": threshold_pct,
+        "abs_floor_ms": abs_floor_ms,
+        "rows": rows,
+        "n_flagged": sum(r["flagged"] for r in rows),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+
+
+def format_report(p: dict) -> str:
+    r, s = p["requests"], p["steps"]
+    lines = [
+        f"servetrace {p.get('family') or '(custom)'}  "
+        f"backend={p['backend']} slots={p['slots']} dp={p['dp']}",
+        f"  requests: {r['submitted']} submitted  {r['completed']} "
+        f"completed  {r['shed']} shed  {r['cancelled']} cancelled  "
+        f"{r['poisoned']} poisoned  ({r['decomposed']} decomposed, "
+        f"{r['nonfinite_skipped']} non-finite skipped)",
+        "  latency decomposition (ms):",
+    ]
+    for comp in COMPONENTS + ("e2e", "ttft"):
+        c = (p.get("components_ms") or {}).get(comp)
+        if c is None:
+            continue
+        lines.append(f"    {comp:<14} p50={c['p50']:9.3f}  "
+                     f"p99={c['p99']:9.3f}  mean={c['mean']:9.3f}")
+    sps = s.get("engine_steps_per_s")
+    sat = s.get("saturated_steps_per_s")
+    lines.append(
+        f"  steps: {s['n']}  "
+        f"{'%.1f' % sps if sps else '-'} steps/s "
+        f"(saturated: {'%.1f' % sat if sat else '-'}, "
+        f"n={s['n_saturated']})  "
+        f"host {s['host_ms_per_step']:.3f} ms/step "
+        f"({s['host_overhead_pct']:.1f}%)  "
+        f"device/step: "
+        f"{s['device_ms_per_step'] if s['device_ms_per_step'] is not None else '-'}")
+    lines.append("  host phases (ms/step):")
+    for ph, v in sorted((s.get("phase_ms_per_step") or {}).items(),
+                        key=lambda kv: -kv[1]):
+        lines.append(f"    {ph:<18} {v:9.4f}")
+    cons = p["conservation"]
+    lines.append(
+        f"  conservation: emitted={cons['emitted_tokens']} "
+        f"terminal={cons['terminal_tokens']} live={cons['live_tokens']} "
+        f"{'OK' if cons['ok'] else 'VIOLATED'}")
+    return "\n".join(lines)
+
+
+def format_diff(d: dict) -> str:
+    lines = [
+        f"servetrace diff [{d['family']}]  threshold "
+        f"±{d['threshold_pct']}% & >{d['abs_floor_ms']} ms",
+    ]
+    for r in d["rows"]:
+        flag = " <-- FLAGGED" if r["flagged"] else ""
+        pct = (f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None
+               else "new")
+        lines.append(
+            f"  {r['kind']:<9} {r['key']:<24} {r['a_ms']:9.3f} -> "
+            f"{r['b_ms']:9.3f}  {r['delta_ms']:+9.3f} ms  {pct:>8}{flag}")
+    lines.append(f"{d['n_flagged']} row(s) above threshold")
+    return "\n".join(lines)

@@ -187,6 +187,75 @@ def phase_of(scope: str) -> str:
     return "other"
 
 
+_COMPUTE_CLASSES = ("mxu-matmul", "pallas-kernel", "vpu-elementwise",
+                    "copy-transpose")
+
+
+def collective_overlap(events: list[dict], op_map: dict[str, HloOp],
+                       divisor: float = 1.0) -> dict[str, dict]:
+    """Compute/collective overlap accounting (ISSUE 12; T3's
+    exposed-vs-hidden communication split is the model — PAPERS.md).
+
+    For every collective device-lane event, the part of its duration
+    overlapped by concurrent COMPUTE events on the SAME pid (device
+    lane) is HIDDEN — the chip was doing useful work while the network
+    moved bytes; the rest is EXPOSED serialization the step actually
+    paid. Compute = mxu-matmul / pallas-kernel / vpu-elementwise /
+    copy-transpose; DMA and other collectives do not hide a collective.
+    Per-pid compute intervals are merged into a disjoint union first so
+    stacked sub-lanes never double-cover.
+
+    Returns ``{phase: {hidden_ms, exposed_ms, overlap_ratio}}`` with the
+    same ``divisor`` convention as ``attribute`` (per-step, per-device
+    ms). Phases with no collectives are absent; a trace with no
+    collectives returns ``{}``."""
+    compute: dict[Any, list[list[float]]] = {}
+    colls: list[tuple[Any, float, float, str]] = []
+    for e in events:
+        op = op_map.get(e.get("name", ""))
+        if op is None or op.opcode in _CONTAINER_OPS:
+            continue
+        cls = classify_op(op)
+        ts = float(e.get("ts", 0.0))
+        t1 = ts + float(e.get("dur", 0))
+        if cls.startswith("collective-"):
+            colls.append((e.get("pid"), ts, t1, phase_of(op.scope)))
+        elif cls in _COMPUTE_CLASSES:
+            compute.setdefault(e.get("pid"), []).append([ts, t1])
+    merged: dict[Any, list[list[float]]] = {}
+    for pid, iv in compute.items():
+        iv.sort()
+        out: list[list[float]] = []
+        for t0, t1 in iv:
+            if out and t0 <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], t1)
+            else:
+                out.append([t0, t1])
+        merged[pid] = out
+    per_phase: dict[str, list[float]] = {}
+    for pid, t0, t1, ph in colls:
+        ov = 0.0
+        for c0, c1 in merged.get(pid, ()):
+            if c1 <= t0:
+                continue
+            if c0 >= t1:
+                break
+            ov += min(c1, t1) - max(c0, t0)
+        ov = min(ov, t1 - t0)
+        acc = per_phase.setdefault(ph, [0.0, 0.0])  # [hidden, exposed]
+        acc[0] += ov
+        acc[1] += (t1 - t0) - ov
+    d = max(divisor, 1e-9)
+    return {
+        ph: {
+            "hidden_ms": round(h / d / 1e3, 4),
+            "exposed_ms": round(x / d / 1e3, 4),
+            "overlap_ratio": round(h / (h + x), 4) if h + x else 0.0,
+        }
+        for ph, (h, x) in per_phase.items()
+    }
+
+
 def count_collectives(op_map: dict[str, HloOp]) -> dict[str, int]:
     """Static per-kind collective instruction counts in the compiled
     module (``-start`` counts the op; ``-done`` is its completion)."""
@@ -325,6 +394,12 @@ def profile_callable(
 
     divisor = iters * max(n_devices, 1)
     phase_class_us, op_rows = attribute(events, op_map, divisor)
+    overlap_by_phase = collective_overlap(events, op_map, divisor)
+    hidden_ms = round(
+        sum(v["hidden_ms"] for v in overlap_by_phase.values()), 4)
+    exposed_ms = round(
+        sum(v["exposed_ms"] for v in overlap_by_phase.values()), 4)
+    coll_total = hidden_ms + exposed_ms
 
     phase_ms = {
         ph: round(sum(c.values()) / divisor / 1e3, 4)
@@ -353,6 +428,15 @@ def profile_callable(
             for ph, c in phase_class_us.items()
         },
         "collectives": count_collectives(op_map),
+        # Compute/collective overlap split (ISSUE 12): hidden = covered
+        # by concurrent same-lane compute, exposed = serialized wall the
+        # step actually paid. Purely additive fields — older profiles
+        # without them diff as 0.0.
+        "collective_hidden_ms": hidden_ms,
+        "collective_exposed_ms": exposed_ms,
+        "collective_overlap_ratio": (
+            round(hidden_ms / coll_total, 4) if coll_total else 0.0),
+        "overlap_by_phase": overlap_by_phase,
         "ops": op_rows[:top],
         "tokens_per_step": tokens_per_step,
         "flops_per_token": flops_per_token,
@@ -681,8 +765,19 @@ def diff_profiles(a: dict, b: dict, threshold_pct: float = 10.0,
             f"profiles are different families: {a.get('family')!r} vs "
             f"{b.get('family')!r} — deltas would be meaningless")
     rows = []
-    for kind, field in (("phase", "phase_ms"), ("class", "class_ms")):
-        av, bv = a.get(field, {}), b.get(field, {})
+    # Overlap rows (ISSUE 12): hidden/exposed collective splits diff
+    # like any phase row; profiles written before the fields existed
+    # contribute 0.0 so old artifacts keep diffing cleanly.
+    sections = [
+        ("phase", a.get("phase_ms", {}), b.get("phase_ms", {})),
+        ("class", a.get("class_ms", {}), b.get("class_ms", {})),
+        ("overlap",
+         {"collective-hidden": a.get("collective_hidden_ms", 0.0),
+          "collective-exposed": a.get("collective_exposed_ms", 0.0)},
+         {"collective-hidden": b.get("collective_hidden_ms", 0.0),
+          "collective-exposed": b.get("collective_exposed_ms", 0.0)}),
+    ]
+    for kind, av, bv in sections:
         for key in sorted(set(av) | set(bv)):
             x, y = av.get(key, 0.0), bv.get(key, 0.0)
             delta = y - x
@@ -725,6 +820,12 @@ def format_profile(p: dict) -> str:
     if p.get("collectives"):
         cs = ", ".join(f"{k}×{v}" for k, v in sorted(p["collectives"].items()))
         lines.append(f"  collectives: {cs}")
+    hid = p.get("collective_hidden_ms", 0.0)
+    exp = p.get("collective_exposed_ms", 0.0)
+    if hid or exp:
+        lines.append(
+            f"  collective overlap: hidden {hid:.3f} ms  exposed "
+            f"{exp:.3f} ms  ratio {p.get('collective_overlap_ratio', 0.0):.2f}")
     lines.append("  phase × class (ms/step):")
     classes = sorted(p.get("class_ms", {}),
                      key=lambda c: -p["class_ms"][c])

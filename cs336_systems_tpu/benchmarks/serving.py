@@ -44,6 +44,17 @@ prefill) and ``shared_kv_bytes`` (high-water of shared-page HBM).
 ``--no-prefix-cache`` is the unshared A/B baseline — streams are
 bit-identical either way (tests/test_prefix_cache.py pins it).
 
+Flight-recorder attribution (ISSUE 12): every cell's row carries the
+latency decomposition folded from the engine's always-on flight log
+(analysis/servetrace.py) — ``ttft_p99_ms``, ``queue_wait_p99_ms``,
+``prefill_stall_p99_ms``, ``decode_p99_ms`` and ``host_overhead_pct``
+(host bookkeeping share of the step wall) — so a p99 regression names
+its component without a rerun; ``--servetrace OUT.json`` additionally
+dumps each cell's full servetrace/v1 artifact for
+``serve_trace_cli --diff``. Non-finite latency samples (the no-clock
+``math.inf`` stamp on cancel/evict paths) are dropped before every
+percentile.
+
 Every cell flushes via ``emit_row`` the moment it completes (``--out``
 makes the cells durable JSONL), and every trace ends with the page-pool
 conservation check — a leaked page fails the cell, which is the CI
@@ -76,6 +87,10 @@ honor_cpu_request()
 
 import jax
 
+# servetrace imports benchmarks.serving only lazily (inside replay), so
+# this module-level import does not cycle
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.analysis.tracekit import write_profile
 from cs336_systems_tpu.models.transformer import (
     TransformerConfig,
     config_for_size,
@@ -134,7 +149,7 @@ def build_requests(profile: str, n: int, prompt_len: int, new_tokens: int,
 
 
 def run_cell(engine: ServingEngine, requests: list[Request],
-             slo_ms: float) -> dict:
+             slo_ms: float, servetrace_path: str | None = None) -> dict:
     """Drive one trace to completion and reduce it to the cell's row.
 
     Per-token latency samples: a request's first sample is time-to-first-
@@ -147,7 +162,16 @@ def run_cell(engine: ServingEngine, requests: list[Request],
     be accounted for exactly once across completed/shed, every shedding
     error must be a retriable ServingError, and ``deadline_goodput_tok_s``
     counts only tokens from requests that finished BY their deadline (=
-    plain goodput when no request carries one)."""
+    plain goodput when no request carries one).
+
+    ISSUE 12: non-finite latency samples are DROPPED before any
+    percentile — a cancelled/evicted request stamped with the no-clock
+    ``math.inf`` fallback must not poison p50/p99/makespan — and the row
+    gains the flight-recorder attribution columns (``ttft_p99_ms`` /
+    ``queue_wait_p99_ms`` / ``prefill_stall_p99_ms`` / ``decode_p99_ms``
+    / ``host_overhead_pct``) folded by analysis/servetrace.py;
+    ``servetrace_path`` additionally dumps the cell's full servetrace/v1
+    artifact."""
     for r in requests:
         engine.submit(r)
     t0 = time.monotonic()
@@ -166,16 +190,32 @@ def run_cell(engine: ServingEngine, requests: list[Request],
         if r.rid not in done or not r.emit_times:  # shed / EOS-at-once
             continue
         lat = np.diff([r.arrival] + r.emit_times)
-        samples.extend(lat.tolist())
-        ttfts.append(lat[0])
+        finite = lat[np.isfinite(lat)]
+        samples.extend(finite.tolist())
+        if lat.size and np.isfinite(lat[0]):
+            ttfts.append(float(lat[0]))
         total_tokens += len(r.tokens)
-        if float(lat.mean()) * 1e3 <= slo_ms:
+        if finite.size and float(finite.mean()) * 1e3 <= slo_ms:
             good_tokens += len(r.tokens)
-        if r.deadline is None or r.finish_time <= r.deadline:
+        fin = r.finish_time
+        fin_ok = fin is not None and np.isfinite(fin)
+        if r.deadline is None or (fin_ok and fin <= r.deadline):
             dl_tokens += len(r.tokens)
-        t_end = max(t_end, r.finish_time)
+        if fin_ok:
+            t_end = max(t_end, fin)
     makespan = max(t_end - min(r.arrival for r in requests), 1e-9)
-    samples = np.asarray(samples) if samples else np.zeros(1)
+    samples = np.asarray(samples) if len(samples) else np.zeros(1)
+
+    # flight-recorder attribution (ISSUE 12): fold the engine's log into
+    # the servetrace artifact and surface the decomposition percentiles
+    art = servetrace.fold(engine)
+    comps = art["components_ms"]
+
+    def _p99(c):
+        return comps[c]["p99"] if comps.get(c) else 0.0
+
+    if servetrace_path:
+        write_profile(art, servetrace_path)
     return {
         "completed": len(results),
         "shed": len(shed),
@@ -198,6 +238,12 @@ def run_cell(engine: ServingEngine, requests: list[Request],
             4),
         "prefill_tokens": engine.prefill_tokens,
         "shared_kv_bytes": engine.shared_kv_bytes_peak,
+        # flight-recorder attribution columns (ISSUE 12)
+        "ttft_p99_ms": _p99("ttft"),
+        "queue_wait_p99_ms": _p99("queue_wait"),
+        "prefill_stall_p99_ms": _p99("prefill_stall"),
+        "decode_p99_ms": _p99("decode"),
+        "host_overhead_pct": art["steps"]["host_overhead_pct"],
     }
 
 
@@ -206,7 +252,8 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
           max_blocks: int, page_block: int, dp: int, seed: int,
           slo_ms: float, out_path: str | None, shared_prefix: int = 0,
           prefix_cache: bool = True,
-          deadline_ms: float = 0.0) -> list[dict]:
+          deadline_ms: float = 0.0,
+          servetrace_path: str | None = None) -> list[dict]:
     params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
     mesh = dp_axis = None
     if dp:
@@ -239,7 +286,14 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
                    "requests": n_requests, "slots": slots,
                    "n_pages": n_pages, "slo_ms": slo_ms,
                    "shared_prefix": shared_prefix, "seed": seed}
-            row.update(run_cell(make_engine(), make_requests(), slo_ms))
+            st_path = None
+            if servetrace_path:
+                # one artifact per cell: insert the cell name so a
+                # multi-cell sweep doesn't overwrite itself
+                stem, ext = os.path.splitext(servetrace_path)
+                st_path = f"{stem}.{row['name']}{ext or '.json'}"
+            row.update(run_cell(make_engine(), make_requests(), slo_ms,
+                                servetrace_path=st_path))
             if deadline_ms > 0:
                 # the admission-control A/B twin: identical seeded
                 # arrivals, DeadlinePolicy instead of strict FIFO —
@@ -312,6 +366,11 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None,
                    help="append each completed cell as a JSON line")
+    p.add_argument("--servetrace", default=None, metavar="OUT.json",
+                   help="dump each cell's servetrace/v1 artifact "
+                        "(flight-recorder latency decomposition, "
+                        "analysis/servetrace.py) — the cell name is "
+                        "inserted before the extension")
     p.add_argument("--latex", default=None)
     args = p.parse_args()
 
@@ -360,7 +419,8 @@ def main() -> None:
                  args.page_block, args.dp, args.seed, args.slo_ms,
                  args.out, shared_prefix=args.shared_prefix,
                  prefix_cache=not args.no_prefix_cache,
-                 deadline_ms=args.deadline_ms)
+                 deadline_ms=args.deadline_ms,
+                 servetrace_path=args.servetrace)
     print_table(results_table(rows, latex_path=args.latex))
 
 

@@ -27,9 +27,17 @@ policies (``DeadlinePolicy`` sheds SLO-unreachable requests),
 the servesan chaos harness (chaos.py — ``python -m
 cs336_systems_tpu.serving.chaos``) that injects known faults and proves
 the detectors fire.
+
+ISSUE 12 adds the flight recorder (flight.py): an always-on host-side
+lifecycle + host-phase log inside the engine — zero device dispatches,
+step program byte-identical recorder on/off — that
+analysis/servetrace.py folds into the CI-diffable servetrace/v1
+artifact (per-request latency decomposition, engine-steps/s with the
+host-phase breakdown, counter windows).
 """
 
 from cs336_systems_tpu.serving.engine import ServingEngine, make_engine_step
+from cs336_systems_tpu.serving.flight import FlightRecorder
 from cs336_systems_tpu.serving.errors import (
     AdmissionImpossible,
     CorruptBlockTable,
@@ -60,6 +68,7 @@ __all__ = [
     "DeadlineExceeded",
     "DeadlinePolicy",
     "FifoPolicy",
+    "FlightRecorder",
     "InvariantViolation",
     "PagePool",
     "PoolExhausted",

@@ -48,6 +48,20 @@ was built for):
   host-side allocator work — the step program and its collective
   contract are byte-identical with the cache on or off.
 
+- FLIGHT RECORDER (ISSUE 12, default on): every request lifecycle
+  transition (submit/shed/admit/running/first-token/finish/cancel/
+  poison) and every dispatched step's six host-phase spans
+  (schedule_admit, prefix_lookup, prefill_dispatch, table_rewrite,
+  step_dispatch, readback_sample — consecutive ``_t(now)`` reads tile
+  the step wall exactly) append to ``self.flight``
+  (serving/flight.py); analysis/servetrace.py folds the log into the
+  canonical servetrace/v1 artifact (latency decomposition,
+  engine-steps/s, counter windows). Pure host-side appends on the
+  existing clock abstraction — zero device dispatches, the jit step
+  program is byte-identical recorder on or off, and the engine makes
+  the SAME clock reads either way so stateful test clocks tick
+  identically.
+
 - ROBUSTNESS (ISSUE 10): every failure is a typed ``serving.errors``
   exception with a ``retriable`` verdict; admission is policy-pluggable
   (``scheduler.DeadlinePolicy`` sheds SLO-unreachable requests with a
@@ -99,6 +113,7 @@ from cs336_systems_tpu.serving.errors import (
     ServingError,
     SlotPoisoned,
 )
+from cs336_systems_tpu.serving.flight import FlightRecorder
 from cs336_systems_tpu.serving.pool import PagePool
 from cs336_systems_tpu.serving.prefix_cache import PrefixCache, params_fingerprint
 from cs336_systems_tpu.serving.scheduler import AdmissionPolicy, Request, Scheduler
@@ -198,7 +213,8 @@ class ServingEngine:
                  mesh=None, dp_axis: str | None = None,
                  tp_axis: str | None = None,
                  clock=None, on_token=None, prefix_cache: bool = True,
-                 policy: AdmissionPolicy | None = None):
+                 policy: AdmissionPolicy | None = None,
+                 flight: bool = True):
         if page_block <= 0 or page_block % 8:
             raise ValueError(
                 f"page block must be a positive multiple of 8, "
@@ -254,6 +270,7 @@ class ServingEngine:
         self.prefill_tokens = 0        # tokens actually run through prefill
         self.shared_kv_bytes_peak = 0  # high-water of shared-page HBM
         self.scheduler = Scheduler(policy)
+        self.flight = FlightRecorder(enabled=flight)
         self.running: dict[int, Request] = {}
         self.results: dict[int, np.ndarray] = {}
         # terminal non-success outcomes (ISSUE 10): rid -> retriable
@@ -290,6 +307,13 @@ class ServingEngine:
             attn_impl=attn_impl, approx_top_k=approx_top_k)
         self._pf_cache = {}
 
+    def _t(self, now: float) -> float:
+        """Recorder timestamp: the wall clock when one is set, else the
+        step's virtual ``now`` — called UNCONDITIONALLY of the
+        recorder's enabled flag so a stateful test clock ticks
+        identically recorder on/off."""
+        return self.clock() if self.clock is not None else now
+
     # -- admission ---------------------------------------------------
 
     def _pages_needed(self, req: Request) -> int:
@@ -323,6 +347,11 @@ class ServingEngine:
                 f"request {req.rid} is already queued or running "
                 f"(duplicate rid)")
         self.scheduler.submit(req)
+        # t = the request's LOGICAL submission time (its arrival), not a
+        # clock read: submit may run before the trace clock starts
+        self.flight.event("submit", req.rid, float(req.arrival),
+                          prompt_tokens=int(req.prompt.size),
+                          max_new_tokens=int(req.max_new_tokens))
 
     def _admit(self, now: float) -> int:
         """Strict-FIFO join: the head request takes a free slot whose
@@ -348,6 +377,8 @@ class ServingEngine:
         for req, err in self.scheduler.shed_expired(now):
             req.finish_time = now
             self.failed[req.rid] = err
+            self.flight.event("shed", req.rid, now,
+                              error=type(err).__name__)
         admitted = 0
         joins = []
         # chain hashes the current join batch will publish, per shard
@@ -378,19 +409,26 @@ class ServingEngine:
                 self.prefill_tokens += req.prompt.size
                 joins.append((slot, req, pages, 0, []))
                 admitted += 1
+                self.flight.event(
+                    "admit", req.rid, self._t(now), slot=slot,
+                    shard=slot // self.slots_per, hit_tokens=0,
+                    suffix_tokens=int(req.prompt.size))
                 continue
 
+            t_lk = self._t(now)
             hashes = (self.prefix_caches[0].chain_hashes(req.prompt)
                       if free_slot else [])
+            self.flight.span("prefix_lookup", t_lk, self._t(now))
             # flush-on-pending-conflict: the blocks this request misses
             # are being published by the batch we're holding — land them
             # first so this request (and the rest of the burst) can hit
             if joins and any(h in pending[k] for k in free_slot
                              for h in hashes):
-                self._prefill_joins(joins)
+                self._prefill_joins(joins, now)
                 joins = []
                 pending = [set() for _ in range(self.dp)]
                 continue
+            t_lk = self._t(now)
             best = None  # (-hit, slot, shard, pages, logits)
             for k in sorted(free_slot):
                 pool, cache = self.pools[k], self.prefix_caches[k]
@@ -404,6 +442,7 @@ class ServingEngine:
                 cand = (-hit, free_slot[k], k, pages, logits)
                 if best is None or cand < best:
                     best = cand
+            self.flight.span("prefix_lookup", t_lk, self._t(now))
             if best is None:
                 break
             neg_hit, slot, shard, hit_pages, cached_logits = best
@@ -421,9 +460,15 @@ class ServingEngine:
             self.prefix_hit_tokens += hit * self.page_block
             self.prefix_prompt_tokens += req.prompt.size
             admitted += 1
+            self.flight.event(
+                "admit", req.rid, self._t(now), slot=slot, shard=shard,
+                hit_tokens=hit * self.page_block,
+                suffix_tokens=max(int(req.prompt.size)
+                                  - hit * self.page_block, 0))
             if cached_logits is not None:
                 # zero-prefill join: the whole prompt is cached and the
                 # publisher's boundary logits replay the join state
+                t_rw = self._t(now)
                 self.logits[slot] = cached_logits
                 self.pos[slot] = req.prompt.size
                 self.active[slot] = 1
@@ -433,12 +478,17 @@ class ServingEngine:
                 self.tables[slot] = tab + [tab[-1]] * (
                     self.max_blocks - len(tab))
                 self._update_shared_peak()
+                t_rw1 = self._t(now)
+                self.flight.span("table_rewrite", t_rw, t_rw1)
+                # decode-ready with zero device work: running == admit
+                self.flight.event("running", req.rid, t_rw1,
+                                  step=self.steps)
                 continue
             self.prefill_tokens += req.prompt.size - hit * self.page_block
             pending[shard].update(hashes[hit:])
             joins.append((slot, req, priv, hit, hit_pages))
         if joins:
-            self._prefill_joins(joins)
+            self._prefill_joins(joins, now)
         return admitted
 
     def _update_shared_peak(self) -> None:
@@ -510,7 +560,7 @@ class ServingEngine:
         self._pf_cache[cache_key] = fn
         return fn
 
-    def _prefill_joins(self, joins) -> None:
+    def _prefill_joins(self, joins, now: float = math.inf) -> None:
         """Prefill the join batch and scatter its pages into the pool.
 
         Shapes are bucketed — join width to a power of two, prompt width
@@ -528,6 +578,7 @@ class ServingEngine:
         each row runs only its uncached tail against its acquired
         prefix pages. Either way, completed rows PUBLISH their full
         prompt blocks into the shard's prefix cache."""
+        t_pf0 = self._t(now)
         blk, dp, npages = self.page_block, self.dp, self.n_pages
         per_shard = [[] for _ in range(dp)]
         for j in joins:
@@ -601,6 +652,13 @@ class ServingEngine:
                 jnp.asarray(pblks), jnp.asarray(dest))
 
         lg = np.asarray(jax.device_get(logits))
+        # the prefill span: operand build + bucket dispatch + logits
+        # readback — the window during which every OTHER running slot's
+        # decode is blocked (servetrace's prefill_stall component)
+        t_pf1 = self._t(now)
+        self.flight.prefill(
+            t_pf0, t_pf1, [j[1].rid for j in joins],
+            tokens=int(sum(j[1].prompt.size - j[3] * blk for j in joins)))
         for k, v in enumerate(per_shard):
             for r, (slot, req, priv, hit, hit_pages) in enumerate(v):
                 self.logits[slot] = lg[k * jw + r]
@@ -622,6 +680,9 @@ class ServingEngine:
             self._update_shared_peak()
         # scratch-never-in-a-table + copy-on-write, checked on every join
         self._validate_tables()
+        self.flight.span("table_rewrite", t_pf1, self._t(now))
+        for slot, req, priv, hit, hit_pages in joins:
+            self.flight.event("running", req.rid, t_pf1, step=self.steps)
 
     def _validate_tables(self) -> None:
         """The block-table contracts, per shard: no scratch id in any
@@ -682,11 +743,15 @@ class ServingEngine:
         if req is not None:
             req.finish_time = when
             self.cancelled[rid] = np.asarray(req.tokens, np.int32)
+            self.flight.event("cancel", rid, when, running=False,
+                              tokens=0)
             return True
         for slot, run in list(self.running.items()):
             if run.rid == rid:
                 self._release_slot(slot, run, when)
                 self.cancelled[rid] = np.asarray(run.tokens, np.int32)
+                self.flight.event("cancel", rid, when, running=True,
+                                  tokens=len(run.tokens))
                 return True
         return False
 
@@ -707,6 +772,8 @@ class ServingEngine:
                 f"logits after {len(req.tokens)} tokens",
                 shard=slot // self.slots_per)
             self._fail_slot(slot, req, err, when)
+            self.flight.event("poison", req.rid, when,
+                              tokens=len(req.tokens))
             out.append((req.rid, err))
         return out
 
@@ -716,21 +783,35 @@ class ServingEngine:
         events (None = finished at EOS without emitting)."""
         if now is None:
             now = self.clock() if self.clock is not None else math.inf
+        step_i = self.steps
+        t_enter = self._t(now)
+        self.flight.begin_step(step_i, t_enter)
         self._admit(now)
         # containment BEFORE dispatch: a poisoned carry never reaches
         # the sampler (joins above may have admitted poisoned prefills)
         self._contain_poisoned(now)
+        t_admit = self._t(now)
+        # schedule_admit = the admit segment minus the lookup/prefill/
+        # rewrite sub-spans recorded inside it
+        self.flight.admit_residual(t_enter, t_admit)
         if not self.running:
+            self.flight.drop_step()  # idle invocation, not a step
             return []
         # copy-on-write, re-checked per dispatch: the step is about to
         # write every active row's block pos // block
         self._validate_tables()
+        t_val = self._t(now)
+        self.flight.span("table_rewrite", t_admit, t_val)
         out = self._step_fn(
             self.params, self._pool, jnp.asarray(self.logits),
             jnp.asarray(self.keys), jnp.asarray(self.pos),
             jnp.asarray(self.active), jnp.asarray(self.row_off),
             jnp.asarray(self.tables))
         self._pool = out[0]
+        # dispatch is async: this span is the HOST cost of launching the
+        # step; the device wait lands in readback_sample's device_get
+        t_disp = self._t(now)
+        self.flight.span("step_dispatch", t_val, t_disp)
         logits, toks, keys, pos = jax.device_get(out[1:])
         # device_get hands back read-only arrays; joins mutate these
         self.logits, self.keys, self.pos = (
@@ -739,22 +820,54 @@ class ServingEngine:
 
         emit_t = self.clock() if self.clock is not None else now
         events = []
+        emitted, evicted = [], []
         for slot in sorted(self.running):
             req = self.running[slot]
             t = int(toks[slot])
             if self.eos_token_id is not None and t == self.eos_token_id:
                 # the oracle's truncation EXCLUDES the EOS token
                 self._finish(slot, req, emit_t)
+                evicted.append(req.rid)
+                self.flight.event("finish", req.rid, emit_t, step=step_i,
+                                  tokens=len(req.tokens), eos=True)
                 events.append((req.rid, None))
                 continue
+            first = not req.tokens
             req.tokens.append(t)
             req.emit_times.append(emit_t)
+            emitted.append(req.rid)
+            if first:
+                self.flight.event("first_token", req.rid, emit_t,
+                                  step=step_i)
             if self.on_token is not None:
                 self.on_token(req.rid, t)
             events.append((req.rid, t))
             if len(req.tokens) >= req.max_new_tokens:
                 self._finish(slot, req, emit_t)
+                evicted.append(req.rid)
+                self.flight.event("finish", req.rid, emit_t, step=step_i,
+                                  tokens=len(req.tokens), eos=False)
+        t_exit = self._t(now)
+        self.flight.span("readback_sample", t_disp, t_exit)
+        self.flight.end_step(
+            t_exit, emitted, evicted,
+            self._counters(now) if self.flight.enabled else {})
         return events
+
+    def _counters(self, now: float) -> dict:
+        """Scheduler/pool/prefix-cache snapshot for the step record —
+        the per-window occupancy/free-pages/hit-rate counters of the
+        servetrace artifact."""
+        return {
+            "running": len(self.running),
+            "queued": len(self.scheduler),
+            "arrived": self.scheduler.depth(now),
+            "free_pages": sum(p.available for p in self.pools),
+            "shared_pages": (sum(len(c) for c in self.prefix_caches)
+                             if self.prefix_caches is not None else 0),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefill_tokens": self.prefill_tokens,
+        }
 
     def run(self, time_fn=None) -> dict[int, np.ndarray]:
         """Drive steps until every submitted request completes; returns

@@ -1,0 +1,149 @@
+"""Flight recorder: always-on host-side lifecycle + host-phase log for
+the continuous-batching engine (ISSUE 12).
+
+The engine records only ``emit_times``/``finish_time``
+(serving/scheduler.py:76), so the benchmark can report a p99 but not
+ATTRIBUTE it — queue wait vs prefill stall vs decode vs host overhead —
+and nothing measures engine-steps/s or the host/device split of the step
+loop. This module is the raw log those numbers fold out of
+(analysis/servetrace.py): an append-only, host-side event list inside
+``ServingEngine``. ZERO device dispatches and zero effect on the jit
+step program — the recorder reads the engine's existing clock
+abstraction and Python state, nothing else, so streams are bit-identical
+recorder on or off (tests/test_servetrace.py pins it on dp8 and
+dp2×tp4, the same contract the prefix cache and blow-up recovery set).
+
+Three append-only streams:
+
+- ``events``: per-request lifecycle dicts — ``submit`` (t = arrival),
+  ``shed``, ``admit`` (slot/shard + prefix-hit and suffix token counts),
+  ``running`` (decode-ready: own prefill landed, or the zero-prefill
+  join), ``first_token``, ``finish`` (EOS/max_new evict, with the stream
+  length), ``cancel``, ``poison``. Per-step emits live on the step
+  records, not here — one event per token would dominate the log.
+- ``steps``: one record per DISPATCHED engine step (idle invocations
+  that admit nothing and run nothing are dropped): enter/exit
+  timestamps, the six host-phase durations (schedule_admit,
+  prefix_lookup, prefill_dispatch, table_rewrite, step_dispatch,
+  readback_sample — consecutive clock reads tile [t0, t1] exactly, so
+  the phases sum to the step wall time by construction), the rids that
+  emitted / evicted this step, and a scheduler/pool/prefix-cache
+  counter snapshot.
+- ``prefills``: every prefill-batch span (t0, t1, rids, tokens) — the
+  join cost that stalls every OTHER running slot's decode, which is the
+  disaggregated-prefill motivation number servetrace's
+  ``prefill_stall`` component measures.
+
+Clock discipline: timestamps come from the engine's ``_t(now)`` —
+``clock()`` when set (wall time in benchmarks), else the step's virtual
+``now``. The engine makes the SAME clock reads whether the recorder is
+enabled or not, so a stateful test clock ticks identically on/off.
+With no clock at all (``now = math.inf``) every duration is inf−inf =
+NaN; ``span`` drops non-finite deltas and counts them in
+``nonfinite_spans``, and the folds skip non-finite samples — the
+non-finite guard ISSUE 12 requires (engine.cancel's math.inf fallback
+must never poison a percentile).
+"""
+
+from __future__ import annotations
+
+import math
+
+PHASES = ("schedule_admit", "prefix_lookup", "prefill_dispatch",
+          "table_rewrite", "step_dispatch", "readback_sample")
+
+
+class FlightRecorder:
+    """Append-only host-side log; ``enabled=False`` keeps every hook a
+    no-op (the A/B twin for the bit-identity test) without changing the
+    engine's clock-read pattern."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.reset()
+
+    def reset(self) -> None:
+        """Drop everything recorded so far (benchmarks reset after the
+        warmup request so compile time doesn't pollute the trace)."""
+        self.events: list[dict] = []
+        self.steps: list[dict] = []
+        self.prefills: list[dict] = []
+        self.nonfinite_spans = 0
+        self._cur: dict | None = None
+
+    # -- request lifecycle -------------------------------------------
+
+    def event(self, kind: str, rid, t: float, **fields) -> None:
+        if self.enabled:
+            self.events.append({"kind": kind, "rid": rid, "t": t,
+                                **fields})
+
+    # -- per-step phase spans ----------------------------------------
+
+    def begin_step(self, i: int, t0: float) -> None:
+        """Open step record ``i`` (the engine's pre-dispatch counter).
+        Spans and prefills recorded until ``end_step`` attach to it."""
+        if self.enabled:
+            self._cur = {"i": i, "t0": t0,
+                         "phases": dict.fromkeys(PHASES, 0.0),
+                         "emits": [], "evicts": []}
+
+    def span(self, phase: str, t0: float, t1: float) -> None:
+        """Accumulate ``t1 - t0`` into the open step's phase. Non-finite
+        deltas (the no-clock math.inf timeline) are dropped and counted,
+        never accumulated — an inf here would poison every fold."""
+        if self._cur is None:
+            return
+        d = t1 - t0
+        if math.isfinite(d):
+            self._cur["phases"][phase] += d
+        else:
+            self.nonfinite_spans += 1
+
+    def admit_residual(self, t0: float, t1: float) -> None:
+        """schedule_admit = the admit segment [t0, t1] MINUS the
+        lookup/prefill/rewrite sub-spans already accumulated inside it —
+        the pure scheduler+allocator bookkeeping. Clamped at 0 (the
+        sub-spans are measured with the same clock, but two reads can
+        tie on a coarse clock)."""
+        if self._cur is None:
+            return
+        seg = t1 - t0
+        if not math.isfinite(seg):
+            self.nonfinite_spans += 1
+            return
+        ph = self._cur["phases"]
+        inner = (ph["prefix_lookup"] + ph["prefill_dispatch"]
+                 + ph["table_rewrite"])
+        ph["schedule_admit"] += max(seg - inner, 0.0)
+
+    def prefill(self, t0: float, t1: float, rids: list,
+                tokens: int) -> None:
+        """One prefill-batch span: dispatch + logits readback for the
+        join batch ``rids`` (``tokens`` prompt tokens actually run).
+        Lands in the global ``prefills`` stream AND the open step's
+        prefill_dispatch phase."""
+        if not self.enabled:
+            return
+        self.prefills.append({"t0": t0, "t1": t1, "rids": list(rids),
+                              "tokens": tokens})
+        self.span("prefill_dispatch", t0, t1)
+
+    def end_step(self, t1: float, emits: list, evicts: list,
+                 counters: dict) -> None:
+        """Commit the open record: exit timestamp, the rids that emitted
+        a token this step, the rids evicted, and the counter snapshot."""
+        if self._cur is None:
+            return
+        self._cur["t1"] = t1
+        self._cur["emits"] = list(emits)
+        self._cur["evicts"] = list(evicts)
+        self._cur["counters"] = counters
+        self.steps.append(self._cur)
+        self._cur = None
+
+    def drop_step(self) -> None:
+        """Discard the open record — the idle early-return path (nothing
+        running after admission). Any prefill spans it recorded stay in
+        the global stream: the work happened, only the step didn't."""
+        self._cur = None

@@ -202,6 +202,13 @@ class Scheduler:
         self._queue = keep
         return shed
 
+    def depth(self, now: float) -> int:
+        """Queued requests that have ARRIVED by ``now`` — the backlog
+        the admission loop can actually see (the flight recorder's
+        per-step queue-depth counter; ``len()`` counts future arrivals
+        too)."""
+        return sum(1 for e in self._queue if e[0] <= now)
+
     def next_arrival(self) -> float | None:
         """Earliest queued arrival time (for the benchmark's idle wait)."""
         return self._queue[0][0] if self._queue else None

@@ -132,6 +132,25 @@ EOF
 fi
 [ "$status" -eq 0 ] && status=$prefix_status
 
+# servetrace gate (ISSUE 12): the serving flight recorder end to end —
+# replay a seeded poisson trace through the shared-prefix engine family,
+# fold the flight log into the servetrace/v1 artifact (decomposition,
+# host-phase breakdown, conservation), then the self-diff must flag
+# nothing. --no-device-join keeps the gate fast (the tracekit join is
+# covered by the engine trace gates above).
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.serve_trace_cli --run \
+    --step serve_engine_prefix --no-device-join \
+    --out /tmp/servetrace_smoke.json
+servetrace_status=$?
+if [ "$servetrace_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.serve_trace_cli \
+        --diff /tmp/servetrace_smoke.json /tmp/servetrace_smoke.json
+    servetrace_status=$?
+fi
+[ "$status" -eq 0 ] && status=$servetrace_status
+
 # gradsan gate: the differential numerics sanitizer on the two composed
 # families whose parity regression it root-caused (the a2a grad sync and
 # the sp/dp flat sync — parallel/ep.py, parallel/sp.py): the sharded

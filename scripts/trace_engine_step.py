@@ -1,0 +1,116 @@
+"""Trace the serving engine's steady-state step at SATURATED slots and
+print the phase-attributed device-time breakdown (a tracekit StepProfile).
+
+Thin wrapper over ``analysis/tracekit.profile_callable`` in the
+trace_decode_step.py mold, but over the ENGINE's jit step program with a
+real engine's state as the operands: a ServingEngine is driven until
+every slot is occupied (submit ``slots`` requests, step through their
+prefills), then a ``donate=False`` twin of ``make_engine_step`` is
+traced on that live state — logits carry, per-slot PRNG chains, paged
+pool, block tables — so the profile is the per-step device cost the
+continuous-batching loop actually pays at full occupancy, not the cold
+fixed-batch decode shape. The host side of the same step (schedule/
+admit, table rewrites, readback) comes from the flight recorder and is
+printed alongside; ``serve_trace_cli --run`` is the full-trace version.
+
+The written StepProfile diffs across runs via ``trace_cli --diff`` and
+joins into the servetrace artifact as ``device_ms_per_step``.
+
+Usage: PYTHONPATH=.:$PYTHONPATH python scripts/trace_engine_step.py \
+          [--slots N] [--out engine.stepprofile.json]
+"""
+
+import argparse
+import time
+
+from cs336_systems_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import jax
+import numpy as np
+
+from cs336_systems_tpu.analysis import tracekit
+from cs336_systems_tpu.analysis.flops import decode_flops_per_token
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    config_for_size,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.serving import Request, ServingEngine
+from cs336_systems_tpu.serving.engine import make_engine_step
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--out", default="engine.stepprofile.json",
+                    help="StepProfile JSON path")
+    args = ap.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = config_for_size("small", context_length=512,
+                              compute_dtype="bfloat16", attn_impl="xla",
+                              scan_layers=False)
+        slots, prompt, new = 32, 64, 128
+    else:
+        cfg = TransformerConfig(vocab_size=64, context_length=64,
+                                d_model=64, d_ff=128, num_layers=2,
+                                num_heads=4)
+        slots, prompt, new = 8, 8, 16
+    if args.slots is not None:
+        slots = args.slots
+    blk = 8 if not on_tpu else 16
+    max_blocks = -(-(prompt + new) // blk)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+
+    # Saturate a real engine: slots requests, all arrived at t=0, long
+    # enough streams that nobody finishes while we trace. After the
+    # prefill step every slot is running.
+    t0 = time.monotonic()
+    engine = ServingEngine(
+        params, cfg, key=jax.random.PRNGKey(0), slots=slots,
+        n_pages=slots * max_blocks, max_blocks=max_blocks,
+        page_block=blk, temperature=0.9, top_k=8,
+        clock=lambda: time.monotonic() - t0)
+    rng = np.random.default_rng(0)
+    for i in range(slots):
+        engine.submit(Request(rid=i,
+                              prompt=rng.integers(0, cfg.vocab_size,
+                                                  size=prompt),
+                              max_new_tokens=new))
+    for _ in range(3):  # prefill-join + settle into steady state
+        engine.step(0.0)
+    assert len(engine.running) == slots, "engine did not saturate"
+
+    # donate=False twin of the engine's own step program: tracekit
+    # re-executes the same bundle, so the live state must survive
+    step = make_engine_step(cfg, blk, temperature=0.9, top_k=8,
+                            donate=False)
+    bundle = (params, engine._pool,
+              np.asarray(engine.logits), np.asarray(engine.keys),
+              np.asarray(engine.pos), np.asarray(engine.active),
+              np.asarray(engine.row_off), np.asarray(engine.tables))
+    profile = tracekit.profile_callable(
+        step, bundle, iters=3 if on_tpu else 1,
+        tokens_per_step=slots,
+        flops_per_token=decode_flops_per_token(
+            cfg, attend_lens=np.asarray(engine.pos, np.int64) + 1),
+        family="serve_engine_saturated",
+    )
+    print(tracekit.format_profile(profile))
+    us_tok = profile["total_device_ms_per_step"] / slots * 1e3
+    print(f"  per slot-token: {us_tok:.1f} us ({slots} saturated slots)")
+    host = [s for s in engine.flight.steps if s["phases"]]
+    if host:
+        n = len(host)
+        tot = {p: sum(s["phases"][p] for s in host) / n * 1e3
+               for p in host[0]["phases"]}
+        breakdown = "  ".join(f"{p}={v:.3f}" for p, v in tot.items())
+        print(f"  host ms/step (flight recorder, {n} steps): {breakdown}")
+    tracekit.write_profile(profile, args.out)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

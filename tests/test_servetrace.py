@@ -1,0 +1,305 @@
+"""Flight-recorder + servetrace tests (ISSUE 12).
+
+Oracle discipline mirrors tests/test_serving_engine.py: the recorder's
+event log is checked against the per-request lifecycle it must describe
+(submit <= admit <= first-token <= finish, one eviction each), the
+latency decomposition is checked for EXACT conservation (components sum
+to e2e — host_overhead is the residual and must never go negative), and
+the headline invariant — the recorder is pure observation — is pinned by
+running the same trace with the recorder on and off on dp8 AND dp2x:tp4
+and demanding bit-identical streams. The spike test reproduces the
+attribution the artifact exists for: a cold straggler prefill mid-trace
+must land in the RUNNING requests' prefill_stall, and dominate p99.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.serving import Request, ServingEngine
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 8
+LENS = [12, 3, 7, 1, 12, 5, 9, 2]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+            for n in LENS]
+
+
+class Tick:
+    """Stateful virtual clock: every read advances by ``dt`` — a fully
+    deterministic timeline in which every recorded span is a positive
+    multiple of dt."""
+
+    def __init__(self, dt: float = 1e-3):
+        self.t, self.dt = 0.0, dt
+
+    def __call__(self) -> float:
+        self.t += self.dt
+        return self.t
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=8, n_pages=32,
+                max_blocks=4, page_block=BLK, temperature=0.9, top_k=8)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+def _drive(params, **kw):
+    """One full trace on the virtual tick clock; returns the engine."""
+    eng = _engine(params, clock=Tick(), **kw)
+    rng = np.random.default_rng(7)
+    for i, n in enumerate(LENS):
+        eng.submit(Request(rid=i,
+                           prompt=rng.integers(0, CFG.vocab_size, size=n),
+                           max_new_tokens=NEW))
+    eng.run()
+    eng.check_idle()
+    return eng
+
+
+# --- lifecycle well-formedness ----------------------------------------
+
+
+def test_lifecycle_well_formed(params):
+    eng = _drive(params)
+    fr = eng.flight
+    by_rid = {}
+    for e in fr.events:
+        by_rid.setdefault(e["rid"], {})[e["kind"]] = e
+    assert set(by_rid) == set(range(len(LENS)))
+    for rid, ev in by_rid.items():
+        for kind in ("submit", "admit", "running", "first_token",
+                     "finish"):
+            assert kind in ev, f"rid {rid} missing {kind}"
+        assert (ev["submit"]["t"] <= ev["admit"]["t"]
+                <= ev["running"]["t"] <= ev["first_token"]["t"]
+                <= ev["finish"]["t"])
+        assert ev["finish"]["tokens"] == len(eng.results[rid])
+        assert ev["admit"]["hit_tokens"] + ev["admit"]["suffix_tokens"] \
+            >= LENS[rid]
+    # every request evicted exactly once, at its finish step
+    evicts = [r for s in fr.steps for r in s["evicts"]]
+    assert sorted(evicts) == sorted(by_rid)
+    # step records are monotone and phase-complete
+    for s in fr.steps:
+        assert s["t0"] <= s["t1"]
+        assert set(s["phases"]) == set(
+            ("schedule_admit", "prefix_lookup", "prefill_dispatch",
+             "table_rewrite", "step_dispatch", "readback_sample"))
+
+
+def test_phase_tiling_exact_and_counters(params):
+    """Consecutive clock reads tile the step wall: the six phases sum to
+    t1 - t0 exactly (the residual IS schedule_admit), and the per-step
+    counters carry the scheduler/pool state."""
+    eng = _drive(params)
+    for s in eng.flight.steps:
+        assert sum(s["phases"].values()) == pytest.approx(
+            s["t1"] - s["t0"], abs=1e-12)
+        # counters sample POST-evict state: the drain step reads 0
+        assert s["counters"]["running"] >= 0
+        assert s["counters"]["free_pages"] >= 0
+    assert any(s["counters"]["running"] > 0 for s in eng.flight.steps)
+    assert eng.flight.nonfinite_spans == 0
+
+
+# --- conservation ------------------------------------------------------
+
+
+def test_emit_conservation(params):
+    eng = _drive(params)
+    art = servetrace.fold(eng)
+    cons = art["conservation"]
+    assert cons["ok"]
+    assert cons["emitted_tokens"] == sum(
+        len(t) for t in eng.results.values())
+    assert cons["live_tokens"] == 0
+    assert art["requests"]["submitted"] == len(LENS)
+    assert art["requests"]["completed"] == len(LENS)
+    assert art["requests"]["decomposed"] == len(LENS)
+    assert art["requests"]["nonfinite_skipped"] == 0
+
+
+# --- decomposition exactness ------------------------------------------
+
+
+def test_decomposition_sums_to_e2e(params):
+    eng = _drive(params)
+    per_req, skipped = servetrace.decompose(eng)
+    assert skipped == 0 and set(per_req) == set(range(len(LENS)))
+    by_rid = {}
+    for e in eng.flight.events:
+        by_rid.setdefault(e["rid"], {})[e["kind"]] = e
+    for rid, r in per_req.items():
+        parts = (r["queue_wait"] + r["prefill_stall"] + r["decode"]
+                 + r["host_overhead"])
+        assert parts == pytest.approx(r["e2e"], abs=1e-9), rid
+        assert r["e2e"] == pytest.approx(
+            by_rid[rid]["finish"]["t"] - by_rid[rid]["submit"]["t"],
+            abs=1e-12)
+        for c in r:
+            assert r[c] is None or r[c] >= 0.0, (rid, c)
+
+
+def test_nonfinite_timeline_skipped_not_poisoned(params):
+    """No clock at all -> every timestamp is the math.inf fallback; the
+    fold must SKIP those requests, not emit inf/nan percentiles."""
+    eng = _engine(params)  # clock=None
+    rng = np.random.default_rng(7)
+    for i, n in enumerate(LENS[:3]):
+        eng.submit(Request(rid=i,
+                           prompt=rng.integers(0, CFG.vocab_size, size=n),
+                           max_new_tokens=NEW))
+    eng.run()
+    per_req, skipped = servetrace.decompose(eng)
+    assert per_req == {} and skipped == 3
+    art = servetrace.fold(eng)
+    assert art["requests"]["nonfinite_skipped"] == 3
+    assert art["components_ms"]["e2e"] is None
+    assert art["conservation"]["ok"]
+    blob = json.dumps(art)  # artifact must stay JSON-clean
+    assert "Infinity" not in blob and "NaN" not in blob
+
+
+# --- recorder is pure observation: bit-identical streams ---------------
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+], ids=["dp8", "dp2xtp4"])
+def test_streams_bit_identical_recorder_on_off(params, prompts,
+                                               mesh_axes, dp, tp):
+    out = {}
+    for flight in (True, False):
+        eng = _engine(params, n_pages=8, mesh=make_mesh(mesh_axes),
+                      dp_axis=dp, tp_axis=tp, flight=flight,
+                      clock=Tick())
+        for i, r in enumerate([4, 1, 6, 0, 7, 2, 5, 3]):
+            eng.submit(Request(rid=r, prompt=prompts[r],
+                               max_new_tokens=NEW,
+                               arrival=float(i) * 0.25))
+        tick = iter(np.arange(0.0, 1e4, 0.5))
+        out[flight] = eng.run(time_fn=lambda: next(tick))
+        eng.check_idle()
+        assert bool(eng.flight.events) == flight
+    assert set(out[True]) == set(out[False])
+    for rid in out[True]:
+        np.testing.assert_array_equal(out[True][rid], out[False][rid])
+
+
+# --- deterministic virtual-clock timeline ------------------------------
+
+
+def test_virtual_clock_timeline_deterministic(params):
+    a, b = _drive(params), _drive(params)
+    assert a.flight.events == b.flight.events
+    assert a.flight.steps == b.flight.steps
+    assert a.flight.prefills == b.flight.prefills
+    assert servetrace.fold(a) == servetrace.fold(b)
+
+
+# --- spike: the straggler's cold prefill lands in prefill_stall --------
+
+
+def test_spike_prefill_stall_dominates_p99(params):
+    """The attribution the artifact exists for: 7 short requests decode
+    on a WARM engine; a straggler with a cold prefill bucket joins
+    mid-flight, and its (compile-heavy, wall-clock) prefill stalls every
+    running stream. prefill_stall must dominate the p99 decomposition —
+    strictly above each other component's p99 and the majority of e2e's.
+    """
+    t0 = time.monotonic()
+    eng = _engine(params, prefix_cache=False,
+                  clock=lambda: time.monotonic() - t0)
+    rng = np.random.default_rng(3)
+    shorts = [rng.integers(0, CFG.vocab_size, size=4).astype(np.int32)
+              for _ in range(7)]
+    # prewarm: compile the shorts' join bucket + the decode step, drain
+    for i, p in enumerate(shorts):
+        eng.submit(Request(rid=100 + i, prompt=p, max_new_tokens=NEW))
+    eng.run()
+    eng.check_idle()
+    eng.flight.reset()
+
+    # the measured trace: same short shapes (warm), then the straggler
+    # joins mid-flight with a prompt-length bucket never compiled
+    for i, p in enumerate(shorts):
+        eng.submit(Request(rid=i, prompt=p, max_new_tokens=NEW,
+                           arrival=eng.clock()))
+    eng.step()  # shorts join (warm prefill) and start decoding
+    assert len(eng.running) == 7
+    eng.submit(Request(
+        rid=7, prompt=rng.integers(0, CFG.vocab_size, size=16),
+        max_new_tokens=NEW, arrival=eng.clock()))
+    eng.run()
+    eng.check_idle()
+
+    art = servetrace.fold(eng)
+    comps = art["components_ms"]
+    stall = comps["prefill_stall"]["p99"]
+    assert stall > comps["queue_wait"]["p99"]
+    assert stall > comps["decode"]["p99"]
+    assert stall > comps["host_overhead"]["p99"]
+    assert stall >= 0.5 * comps["e2e"]["p99"]
+
+
+# --- CLI exit codes ----------------------------------------------------
+
+
+def test_cli_run_selfdiff_report_exit_codes(params, tmp_path):
+    from cs336_systems_tpu.analysis import serve_trace_cli
+
+    out = str(tmp_path / "st.json")
+    assert serve_trace_cli.main(
+        ["--run", "--step", "serve_engine", "--no-device-join",
+         "--requests", "6", "--out", out]) == 0
+    assert serve_trace_cli.main(["--diff", out, out]) == 0  # self-diff
+    assert serve_trace_cli.main(["--report", out]) == 0
+    assert serve_trace_cli.main(["--list"]) == 0
+
+    # a real regression (>2 ms and >50%) must exit 1
+    with open(out) as f:
+        art = json.load(f)
+    worse = json.loads(json.dumps(art))
+    c = worse["components_ms"]["e2e"]
+    c["p99"] = c["p99"] * 10 + 100.0
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump(worse, f)
+    assert serve_trace_cli.main(["--diff", out, bad]) == 1
+
+    # unknown family and family-mismatched diff are build errors: 2
+    assert serve_trace_cli.main(["--run", "--step", "nope"]) == 2
+    other = json.loads(json.dumps(art))
+    other["family"] = "some_other_family"
+    mism = str(tmp_path / "mism.json")
+    with open(mism, "w") as f:
+        json.dump(other, f)
+    assert serve_trace_cli.main(["--diff", out, mism]) == 2

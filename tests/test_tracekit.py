@@ -226,6 +226,93 @@ def test_summarize_trace_explicit_n_devices(tmp_path):
     assert res.total_ms == pytest.approx(0.4)
 
 
+# --- collective overlap (ISSUE 12 satellite) --------------------------------
+
+
+def _oev(name, ts, dur, pid=1):
+    return {"ph": "X", "pid": pid, "tid": 1, "ts": ts, "dur": dur,
+            "name": name}
+
+
+_OVERLAP_OPS = {
+    "all-reduce.1": HloOp("all-reduce", "jit(step)/blk/ffn/psum"),
+    "all-gather.2": HloOp("all-gather", "jit(step)/fwd/attn/ag"),
+    "dot.1": HloOp("dot", "jit(step)/fwd/ffn/dot_general"),
+    "fusion.2": HloOp("fusion", "jit(step)/fwd/ffn/silu"),
+    "copy-start.3": HloOp("copy-start", ""),       # dma: must not hide
+    "while.9": HloOp("while", ""),                 # container: skipped
+}
+
+
+def test_collective_overlap_oracle():
+    """Hand-built timeline: a [0,100] collective against compute at
+    [0,40] and [60,80] on the SAME lane -> hidden 60 us, exposed 40 us.
+    A second collective on a lane whose only compute lives on ANOTHER
+    pid must come out fully exposed — cross-lane compute never hides."""
+    events = [
+        _oev("all-reduce.1", 0, 100),
+        _oev("dot.1", 0, 40),
+        _oev("fusion.2", 60, 20),
+        _oev("copy-start.3", 0, 100),       # concurrent DMA: ignored
+        _oev("while.9", 0, 100),            # container: ignored
+        _oev("all-gather.2", 0, 50, pid=2),
+        _oev("dot.1", 0, 50, pid=3),        # other lane: cannot hide pid 2
+    ]
+    ov = tracekit.collective_overlap(events, _OVERLAP_OPS, divisor=1.0)
+    assert ov["fwd-ffn"] == {"hidden_ms": 0.06, "exposed_ms": 0.04,
+                             "overlap_ratio": 0.6}
+    assert ov["fwd-attn"] == {"hidden_ms": 0.0, "exposed_ms": 0.05,
+                              "overlap_ratio": 0.0}
+
+
+def test_collective_overlap_merges_stacked_compute():
+    """Two overlapping compute events must union, not double-cover: a
+    [0,50] collective against compute [0,30] and [20,60] hides 50 us
+    (the full span), never 80."""
+    events = [
+        _oev("all-reduce.1", 0, 50),
+        _oev("dot.1", 0, 30),
+        _oev("fusion.2", 20, 40),
+    ]
+    ov = tracekit.collective_overlap(events, _OVERLAP_OPS, divisor=1.0)
+    assert ov["fwd-ffn"] == {"hidden_ms": 0.05, "exposed_ms": 0.0,
+                             "overlap_ratio": 1.0}
+
+
+def test_collective_overlap_empty_without_collectives():
+    assert tracekit.collective_overlap(
+        [_oev("dot.1", 0, 100)], _OVERLAP_OPS) == {}
+
+
+def test_diff_covers_overlap_fields_and_absent_is_zero():
+    """Old profiles (written before the overlap fields existed) diff as
+    0.0; a real exposed-time regression is a flagged overlap row."""
+    a = _profile(10.0, {"fwd-ffn": 10.0}, {"mxu-matmul": 10.0})
+    b = dict(_profile(10.0, {"fwd-ffn": 10.0}, {"mxu-matmul": 10.0}),
+             collective_hidden_ms=1.0, collective_exposed_ms=5.0)
+    d = diff_profiles(a, b)
+    rows = {r["key"]: r for r in d["rows"] if r["kind"] == "overlap"}
+    assert rows["collective-hidden"]["a_ms"] == 0.0
+    assert rows["collective-exposed"]["flagged"]
+    # identical profiles (both without the fields) flag nothing
+    assert diff_profiles(a, dict(a))["n_flagged"] == 0
+
+
+@pytest.mark.parametrize("family", ["train_tp", "train_ep_a2a"])
+def test_profile_step_overlap_fields(family):
+    """Real collective-bearing families carry the overlap split, and it
+    conserves: hidden + exposed == total collective class time (same
+    events, same divisor — only the partition is new)."""
+    p = tracekit.profile_step(family, iters=1)
+    coll_total = sum(v for c, v in p["class_ms"].items()
+                     if c.startswith("collective-"))
+    hid, exp = p["collective_hidden_ms"], p["collective_exposed_ms"]
+    assert hid >= 0.0 and exp >= 0.0
+    assert hid + exp == pytest.approx(coll_total, abs=1e-2)
+    assert 0.0 <= p["collective_overlap_ratio"] <= 1.0
+    assert set(p["overlap_by_phase"]) <= set(p["phase_ms"]) | {"other"}
+
+
 # --- diffing ----------------------------------------------------------------
 
 
