@@ -375,3 +375,123 @@ def check_hbm_budget(name: str, budget_bytes: int) -> list[Violation]:
         f"[{worst.get('class', '?')}]) — run mem_cli --step {name} for "
         "the composition, or raise the registry budget if intentional",
     )]
+
+
+# jaxpr collective primitive -> HLO collective opcode, for reconciling a
+# contract's static call-site counts (jaxpr granularity) with schedkit's
+# DAG census of the compiled module (HLO granularity). pmean traces to
+# psum + div, so it is already covered by the psum row.
+PRIM_TO_HLO_COLLECTIVE = {
+    "psum": "all-reduce",
+    "all_gather": "all-gather",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+    "reduce_scatter": "reduce-scatter",
+}
+
+
+def check_collective_slack(name: str, floors: dict[str, float],
+                           profile: dict | None = None) -> list[Violation]:
+    """Rule ``collective-zero-slack``: each collective kind the family
+    declares a slack floor for must keep at least that much analytic
+    dependence-independent compute schedulable against it (summed over
+    the kind's collectives — schedkit's slack table). A kind whose slack
+    pool collapses below its floor WILL serialize: the scheduler has
+    nothing to hide the communication behind, which is exactly the
+    regression an accidental dependency (e.g. chaining every grad
+    through one psum's result) introduces. Floors are declared by the
+    family's ``lint_contract()`` (tp/tp_sp/ep) and calibrated ~4x below
+    the value measured on the registry's tiny CPU-mesh shapes, so they
+    absorb compiler-version scheduling drift but not a structural
+    serialization."""
+    from cs336_systems_tpu.analysis import schedkit
+
+    if profile is None:
+        try:
+            profile = schedkit.profile_family_cached(name)
+        except Exception as e:  # noqa: BLE001 — unanalyzable step = finding
+            return [Violation(
+                "collective-zero-slack", name,
+                f"schedkit failed to analyze the step: "
+                f"{type(e).__name__}: {e}")]
+    pool: dict[str, float] = {}
+    for r in profile.get("collective_rows", []):
+        pool[r["kind"]] = pool.get(r["kind"], 0.0) + r["slack_ms"]
+    out = []
+    for kind, floor in sorted(floors.items()):
+        have = pool.get(kind)
+        if have is None:
+            out.append(Violation(
+                "collective-zero-slack", name,
+                f"contract declares a slack floor for {kind} but the "
+                f"compiled module has no {kind} collectives — the "
+                "contract and the lowering have drifted apart"))
+        elif have < floor:
+            out.append(Violation(
+                "collective-zero-slack", name,
+                f"total dependence-independent compute schedulable "
+                f"against {kind} is {have:.6f} ms, below the declared "
+                f"floor {floor:.6f} ms — the collective(s) will "
+                f"serialize; run sched_cli --step {name} for the slack "
+                "table and find the dependency that consumed the pool"))
+    return out
+
+
+def check_collective_count_consistency(
+        name: str, expected: dict[str, int], *, gspmd: bool = False,
+        profile: dict | None = None) -> list[Violation]:
+    """Rule ``collective-count-consistency``: schedkit's DAG collective
+    census of the compiled module must reconcile with the rest of the
+    toolchain — the tripwire that keeps the analyzers from drifting
+    apart silently. Two comparisons:
+
+    1. schedkit's census (its own DAG walk over entry-reachable
+       computations) must EQUAL tracekit's census of the same module
+       text (``op_map_census`` in the artifact) — two independent
+       parsers of one module.
+    2. the census must reconcile with the lint contract's static jaxpr
+       counts under the prim→opcode map: EXACT for pure shard_map
+       lowerings (each jaxpr call site lowers to one HLO collective;
+       scan bodies count once in both), and a LOWER BOUND when
+       ``gspmd=True`` (tp/tp_sp: the partitioner inserts collectives
+       beyond the explicit shard_map islands, so the census must be a
+       per-kind superset of the declared sites)."""
+    from cs336_systems_tpu.analysis import schedkit
+
+    if profile is None:
+        try:
+            profile = schedkit.profile_family_cached(name)
+        except Exception as e:  # noqa: BLE001 — unanalyzable step = finding
+            return [Violation(
+                "collective-count-consistency", name,
+                f"schedkit failed to analyze the step: "
+                f"{type(e).__name__}: {e}")]
+    census = profile.get("collectives", {})
+    cross = profile.get("op_map_census", {})
+    out = []
+    if census != cross:
+        out.append(Violation(
+            "collective-count-consistency", name,
+            f"schedkit's DAG census {census} disagrees with tracekit's "
+            f"op-map census {cross} of the same compiled module — the "
+            "two HLO parsers have drifted apart"))
+    mapped: dict[str, int] = {}
+    for prim, n in expected.items():
+        opcode = PRIM_TO_HLO_COLLECTIVE.get(prim)
+        if opcode is None:
+            continue
+        mapped[opcode] = mapped.get(opcode, 0) + n
+    for opcode in sorted(set(mapped) | set(census)):
+        want = mapped.get(opcode, 0)
+        have = census.get(opcode, 0)
+        ok = have >= want if gspmd else have == want
+        if not ok:
+            rel = "at least" if gspmd else "exactly"
+            out.append(Violation(
+                "collective-count-consistency", name,
+                f"compiled module carries {have} {opcode} collective(s), "
+                f"contract's static count maps to {rel} {want} — either "
+                "the step gained/lost a collective (update "
+                "lint_contract()) or a shard_map island leaked into a "
+                "path that should be sharding-annotated"))
+    return out

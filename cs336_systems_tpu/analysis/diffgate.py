@@ -1,0 +1,72 @@
+"""diffgate: the shared dual-noise-gate behind every ``--diff``.
+
+tracekit, memkit, servetrace and schedkit all package the same
+regression-gate idea — "compare artifacts, not walls" — and before
+ISSUE 13 each reimplemented the identical gate: a row FLAGS only when
+BOTH trips fire, |Δ| > ``abs_floor`` (absolute jitter floor: device-lane
+timings move by tens of µs, layouts shuffle small buffers, host walls
+swing ms) AND |Δ%| > ``threshold_pct`` of the baseline (relative gate;
+a baseline of exactly 0 flags on the absolute floor alone, since the
+relative delta is undefined/infinite). Identical artifacts flag
+nothing, so a self-diff is always exit 0.
+
+This module is that one gate. Callers provide (kind, key, a, b) rows in
+whatever unit their artifact uses (``unit`` names the row fields:
+``a_ms``/``b_ms``/``delta_ms`` or ``a_bytes``/...), and get back the
+canonical diff dict {family, threshold_pct, abs_floor_<unit>, rows,
+n_flagged} they may extend with artifact-specific headline fields.
+``exit_code`` is the one-line CLI plumbing: 0 clean, 1 flagged.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+_INF = float("inf")
+
+
+def check_same_family(a: dict, b: dict, noun: str = "profiles") -> None:
+    """Raise ValueError unless both artifacts are the same family —
+    deltas across families would be meaningless."""
+    if a.get("family") != b.get("family"):
+        raise ValueError(
+            f"{noun} are different families: {a.get('family')!r} vs "
+            f"{b.get('family')!r} — deltas would be meaningless")
+
+
+def gate_row(kind: str, key: str, x, y, threshold_pct: float,
+             abs_floor: float, unit: str = "ms",
+             ndigits: int | None = 4) -> dict:
+    """One gated diff row. ``ndigits=None`` keeps the delta exact
+    (integer units like bytes); the percent is always rounded to 0.1."""
+    delta = y - x
+    pct = (delta / x * 100.0) if x else (_INF if y else 0.0)
+    return {
+        "kind": kind, "key": key, f"a_{unit}": x, f"b_{unit}": y,
+        f"delta_{unit}": round(delta, ndigits) if ndigits is not None
+        else delta,
+        "delta_pct": round(pct, 1) if pct != _INF else None,
+        "flagged": abs(delta) > abs_floor
+        and (x == 0 or abs(pct) > threshold_pct),
+    }
+
+
+def build_diff(family, pairs: Iterable[tuple], threshold_pct: float,
+               abs_floor: float, unit: str = "ms",
+               ndigits: int | None = 4) -> dict:
+    """The canonical diff dict from (kind, key, a, b) rows."""
+    rows = [gate_row(kind, key, x, y, threshold_pct, abs_floor,
+                     unit=unit, ndigits=ndigits)
+            for kind, key, x, y in pairs]
+    return {
+        "family": family,
+        "threshold_pct": threshold_pct,
+        f"abs_floor_{unit}": abs_floor,
+        "rows": rows,
+        "n_flagged": sum(r["flagged"] for r in rows),
+    }
+
+
+def exit_code(diff: dict) -> int:
+    """CI plumbing: 1 when any row flagged, else 0."""
+    return 1 if diff.get("n_flagged") else 0

@@ -1,0 +1,188 @@
+"""Shared parser for optimized (post-scheduling) HLO module text.
+
+Extracted from ``analysis/memkit.py`` (ISSUE 13) so the liveness analyzer
+(memkit) and the schedule analyzer (schedkit) walk the SAME parse of the
+same module instead of each maintaining a drifting copy of the regexes.
+memkit re-exports every name here for backward compatibility.
+
+The module text this parses is ``compiled.as_text()`` of a jit-compiled
+executable: the OPTIMIZED module, which on the CPU and TPU backends is
+SCHEDULED (``is_scheduled=true`` in the header) — instruction order IS
+the execution schedule. That property is what makes both downstream
+analyses possible: liveness reconstruction (memkit) needs def/last-use
+positions in the real schedule, and critical-path/slack analysis
+(schedkit) needs the dependence edges (operands + control-predecessors)
+of the scheduled program.
+
+Granularity is module-text level: one ``Instr`` per printed instruction,
+with operands, called computations (``while``/``conditional``/``call``/
+``fusion`` bodies), result-type byte size, named-scope metadata, and —
+for schedkit's cost model — the raw result-type string and the full
+attribute tail (dot dimension numbers, ``control-predecessors={...}``,
+``replica_groups`` live there).
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
+
+
+def shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO type string; tuple types sum their leaves.
+    Unknown leaf types (token, opaque) count 0."""
+    total = 0
+    for m in _SHAPE_RE.finditer(type_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def shape_dims(type_str: str) -> list[int]:
+    """Dimension list of the FIRST array shape in an HLO type string
+    (scalar -> []); returns None when no known-dtype shape is present."""
+    for m in _SHAPE_RE.finditer(type_str):
+        if m.group(1) not in _DTYPE_BYTES:
+            continue
+        dims = m.group(2)
+        return [int(d) for d in dims.split(",") if d]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Parsing the optimized (scheduled) HLO text
+
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s+->\s+.*\{\s*$")
+_INSTR_RE = re.compile(
+    r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"(\(.*?\)|[a-z]\w*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"([a-z][a-z0-9\-]*)\((.*)$")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_CALLED_ONE_RE = re.compile(
+    r"(?:body|condition|calls|to_apply|true_computation|false_computation)"
+    r"=%?([\w.\-]+)")
+_CALLED_LIST_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+_OP_NAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
+# the gte attribute is ", index=N"; tuple TYPE strings carry /*index=N*/
+# comments every few elements which must not match (a real bug once)
+_GTE_INDEX_RE = re.compile(r"(?<!/\*)\bindex=(\d+)")
+_PARAM_IDX_RE = re.compile(r"^\s*(\d+)\)")
+# module-header donation map entries: {out_idx}: (param_number, {...}, kind)
+_IO_ALIAS_PAIR_RE = re.compile(r"\{\s*(\d*)\s*\}:\s*\(\s*(\d+)\s*,")
+_CONTROL_PRED_RE = re.compile(r"control-predecessors=\{([^}]*)\}")
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                   "reduce-scatter", "collective-permute",
+                   "collective-broadcast")
+
+ALIAS_OPS = {"get-tuple-element", "tuple", "bitcast", "while",
+             "optimization-barrier", "dynamic-update-slice"}
+NO_ALLOC = {"parameter", "constant"} | ALIAS_OPS
+
+
+class Instr:
+    """One parsed HLO instruction (module-text granularity)."""
+
+    __slots__ = ("name", "opcode", "nbytes", "operands", "called", "scope",
+                 "root", "gte_index", "param_idx", "type_str", "attrs")
+
+
+def parse_io_aliases(hlo_text: str) -> dict[int, int]:
+    """``input_output_alias`` donation map from the HloModule header:
+    flat output index -> parameter number. Nested shape indices (not
+    produced by jit's flat tuples) are ignored."""
+    head = hlo_text.split("\n", 1)[0]
+    start = head.find("input_output_alias={")
+    if start < 0:
+        return {}
+    # the map nests braces ({0}: (0, {}, may-alias)) — regexes stop at
+    # the first inner '}', so extract the block by brace counting
+    i = head.index("{", start)
+    depth, j = 0, i
+    for j in range(i, len(head)):
+        depth += {"{": 1, "}": -1}.get(head[j], 0)
+        if depth == 0:
+            break
+    block = head[i:j + 1]
+    out = {}
+    for pair in _IO_ALIAS_PAIR_RE.finditer(block):
+        out_idx = int(pair.group(1)) if pair.group(1) else 0
+        out[out_idx] = int(pair.group(2))
+    return out
+
+
+def control_predecessors(ins: Instr) -> list[str]:
+    """Instruction names listed in ``control-predecessors={...}`` — the
+    scheduler's explicit ordering edges, part of the true dependence DAG
+    alongside operands."""
+    m = _CONTROL_PRED_RE.search(ins.attrs)
+    if not m:
+        return []
+    return [s.strip().lstrip("%") for s in m.group(1).split(",")
+            if s.strip()]
+
+
+def parse_module(hlo_text: str):
+    """(computations, entry_name): every computation as an ordered list of
+    ``Instr``. The optimized module of a compiled CPU/TPU executable is
+    SCHEDULED (``is_scheduled=true``): instruction order IS the execution
+    schedule, which is what makes liveness reconstruction possible."""
+    comps: dict[str, list[Instr]] = {}
+    cur = None
+    entry = None
+    for line in hlo_text.splitlines():
+        if cur is None:
+            if "{" in line and "->" in line:
+                m = _COMP_RE.match(line.strip())
+                if m:
+                    cur = m.group(2)
+                    comps[cur] = []
+                    if m.group(1):
+                        entry = cur
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        ins = Instr()
+        ins.root = bool(m.group(1))
+        ins.name = m.group(2)
+        ins.opcode = m.group(4)
+        rest = m.group(5)
+        ins.type_str = m.group(3)
+        ins.attrs = rest
+        ins.nbytes = shape_bytes(m.group(3))
+        cut = rest.find("metadata=")
+        args_part = rest if cut < 0 else rest[:cut]
+        ins.operands = _OPERAND_RE.findall(args_part)
+        ins.called = _CALLED_ONE_RE.findall(rest)
+        lm = _CALLED_LIST_RE.search(rest)
+        if lm:
+            ins.called += [s.strip().lstrip("%")
+                           for s in lm.group(1).split(",")]
+        ins.operands = [o for o in ins.operands if o not in ins.called]
+        gm = _GTE_INDEX_RE.search(rest)
+        ins.gte_index = int(gm.group(1)) if gm else None
+        pm = (_PARAM_IDX_RE.match(rest)
+              if ins.opcode == "parameter" else None)
+        ins.param_idx = int(pm.group(1)) if pm else None
+        sm = _OP_NAME_RE.search(rest)
+        ins.scope = sm.group(1) if sm else ""
+        comps[cur].append(ins)
+    if entry is None and comps:
+        entry = next(iter(comps))
+    return comps, entry

@@ -70,6 +70,12 @@ def lint_step(name: str, traced: registry.Traced) -> list[Violation]:
     budget = registry.HBM_BUDGET_BYTES.get(name)
     if budget:
         out += contracts.check_hbm_budget(name, budget)
+    if name in registry.SCHED_CENSUS_FAMILIES and expected is not None:
+        out += contracts.check_collective_count_consistency(
+            name, expected, gspmd=bool(c.get("gspmd_collectives")))
+    floors = c.get("collective_slack_floor_ms")
+    if floors:
+        out += contracts.check_collective_slack(name, floors)
     return out
 
 

@@ -99,7 +99,8 @@ def main(argv=None) -> int:
             abs_floor_bytes=int(args.abs_floor_mb * (1 << 20)))
         print(json.dumps(d, indent=2) if args.json
               else memkit.format_diff(d))
-        return 1 if d["n_flagged"] else 0
+        from cs336_systems_tpu.analysis import diffgate
+        return diffgate.exit_code(d)
 
     if args.explain_oom:
         with open(args.explain_oom) as f:

@@ -77,141 +77,20 @@ CLASSES = ("params", "optimizer-state", "batch", "activation-stash",
            "gmm-residual", "kv-cache", "kv-shared", "kv-private",
            "collective", "constant", "output", "temp")
 
-_DTYPE_BYTES = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-    "f64": 8, "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
-}
-_SHAPE_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
-
-
-def shape_bytes(type_str: str) -> int:
-    """Total bytes of an HLO type string; tuple types sum their leaves.
-    Unknown leaf types (token, opaque) count 0."""
-    total = 0
-    for m in _SHAPE_RE.finditer(type_str):
-        dt, dims = m.group(1), m.group(2)
-        if dt not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-# ---------------------------------------------------------------------------
-# Parsing the optimized (scheduled) HLO text
-
-_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s+->\s+.*\{\s*$")
-_INSTR_RE = re.compile(
-    r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*"
-    r"(\(.*?\)|[a-z]\w*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
-    r"([a-z][a-z0-9\-]*)\((.*)$")
-_OPERAND_RE = re.compile(r"%([\w.\-]+)")
-_CALLED_ONE_RE = re.compile(
-    r"(?:body|condition|calls|to_apply|true_computation|false_computation)"
-    r"=%?([\w.\-]+)")
-_CALLED_LIST_RE = re.compile(r"branch_computations=\{([^}]*)\}")
-_OP_NAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
-# the gte attribute is ", index=N"; tuple TYPE strings carry /*index=N*/
-# comments every few elements which must not match (a real bug once)
-_GTE_INDEX_RE = re.compile(r"(?<!/\*)\bindex=(\d+)")
-_PARAM_IDX_RE = re.compile(r"^\s*(\d+)\)")
-# module-header donation map entries: {out_idx}: (param_number, {...}, kind)
-_IO_ALIAS_PAIR_RE = re.compile(r"\{\s*(\d*)\s*\}:\s*\(\s*(\d+)\s*,")
-
-_COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
-                   "reduce-scatter", "collective-permute",
-                   "collective-broadcast")
-
-ALIAS_OPS = {"get-tuple-element", "tuple", "bitcast", "while",
-             "optimization-barrier", "dynamic-update-slice"}
-NO_ALLOC = {"parameter", "constant"} | ALIAS_OPS
-
-
-class Instr:
-    """One parsed HLO instruction (module-text granularity)."""
-
-    __slots__ = ("name", "opcode", "nbytes", "operands", "called", "scope",
-                 "root", "gte_index", "param_idx")
-
-
-def parse_io_aliases(hlo_text: str) -> dict[int, int]:
-    """``input_output_alias`` donation map from the HloModule header:
-    flat output index -> parameter number. Nested shape indices (not
-    produced by jit's flat tuples) are ignored."""
-    head = hlo_text.split("\n", 1)[0]
-    start = head.find("input_output_alias={")
-    if start < 0:
-        return {}
-    # the map nests braces ({0}: (0, {}, may-alias)) — regexes stop at
-    # the first inner '}', so extract the block by brace counting
-    i = head.index("{", start)
-    depth, j = 0, i
-    for j in range(i, len(head)):
-        depth += {"{": 1, "}": -1}.get(head[j], 0)
-        if depth == 0:
-            break
-    block = head[i:j + 1]
-    out = {}
-    for pair in _IO_ALIAS_PAIR_RE.finditer(block):
-        out_idx = int(pair.group(1)) if pair.group(1) else 0
-        out[out_idx] = int(pair.group(2))
-    return out
-
-
-def parse_module(hlo_text: str):
-    """(computations, entry_name): every computation as an ordered list of
-    ``Instr``. The optimized module of a compiled CPU/TPU executable is
-    SCHEDULED (``is_scheduled=true``): instruction order IS the execution
-    schedule, which is what makes liveness reconstruction possible."""
-    comps: dict[str, list[Instr]] = {}
-    cur = None
-    entry = None
-    for line in hlo_text.splitlines():
-        if cur is None:
-            if "{" in line and "->" in line:
-                m = _COMP_RE.match(line.strip())
-                if m:
-                    cur = m.group(2)
-                    comps[cur] = []
-                    if m.group(1):
-                        entry = cur
-            continue
-        if line.strip() == "}":
-            cur = None
-            continue
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        ins = Instr()
-        ins.root = bool(m.group(1))
-        ins.name = m.group(2)
-        ins.opcode = m.group(4)
-        rest = m.group(5)
-        ins.nbytes = shape_bytes(m.group(3))
-        cut = rest.find("metadata=")
-        args_part = rest if cut < 0 else rest[:cut]
-        ins.operands = _OPERAND_RE.findall(args_part)
-        ins.called = _CALLED_ONE_RE.findall(rest)
-        lm = _CALLED_LIST_RE.search(rest)
-        if lm:
-            ins.called += [s.strip().lstrip("%")
-                           for s in lm.group(1).split(",")]
-        ins.operands = [o for o in ins.operands if o not in ins.called]
-        gm = _GTE_INDEX_RE.search(rest)
-        ins.gte_index = int(gm.group(1)) if gm else None
-        pm = (_PARAM_IDX_RE.match(rest)
-              if ins.opcode == "parameter" else None)
-        ins.param_idx = int(pm.group(1)) if pm else None
-        sm = _OP_NAME_RE.search(rest)
-        ins.scope = sm.group(1) if sm else ""
-        comps[cur].append(ins)
-    if entry is None and comps:
-        entry = next(iter(comps))
-    return comps, entry
+# The module parser lives in analysis/hlo.py (extracted in ISSUE 13 so
+# schedkit walks the same parse); every name is re-exported here because
+# this was its original home and tests/callers import from memkit.
+from cs336_systems_tpu.analysis.hlo import (  # noqa: F401
+    _COLLECTIVE_OPS,
+    _DTYPE_BYTES,
+    _SHAPE_RE,
+    ALIAS_OPS,
+    NO_ALLOC,
+    Instr,
+    parse_io_aliases,
+    parse_module,
+    shape_bytes,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -891,43 +770,27 @@ def profile_family(family: str, top: int = 12) -> dict:
 
 def diff_memprofiles(a: dict, b: dict, threshold_pct: float = 10.0,
                      abs_floor_bytes: int = 1 << 20) -> dict:
-    """Per-metric deltas between two memprofiles. A row is FLAGGED only
-    when BOTH gates trip: |Δ| > ``abs_floor_bytes`` (layout/scheduling
+    """Per-metric deltas between two memprofiles, through the shared
+    dual noise gate (``analysis/diffgate.py``): a row is FLAGGED only
+    when BOTH gates trip, |Δ| > ``abs_floor_bytes`` (layout/scheduling
     jitter moves small buffers around compile to compile) and |Δ%| >
     ``threshold_pct`` of the baseline — identical profiles flag
     nothing. Exit-1 gating on n_flagged is mem_cli --diff."""
-    if a.get("family") != b.get("family"):
-        raise ValueError(
-            f"profiles are different families: {a.get('family')!r} vs "
-            f"{b.get('family')!r} — deltas would be meaningless")
-    rows = []
+    from cs336_systems_tpu.analysis import diffgate
 
-    def add(kind, key, x, y):
-        delta = y - x
-        pct = (delta / x * 100.0) if x else (float("inf") if y else 0.0)
-        rows.append({
-            "kind": kind, "key": key, "a_bytes": x, "b_bytes": y,
-            "delta_bytes": delta,
-            "delta_pct": round(pct, 1) if pct != float("inf") else None,
-            "flagged": abs(delta) > abs_floor_bytes
-            and (x == 0 or abs(pct) > threshold_pct),
-        })
-
-    add("total", "peak_bytes", a.get("peak_bytes", 0), b.get("peak_bytes", 0))
+    diffgate.check_same_family(a, b)
+    pairs = [("total", "peak_bytes",
+              a.get("peak_bytes", 0), b.get("peak_bytes", 0))]
     for kind, field in (("phase", "phase_peak_bytes"),
                         ("class", "composition_bytes")):
         av, bv = a.get(field, {}), b.get(field, {})
-        for key in sorted(set(av) | set(bv)):
-            add(kind, key, av.get(key, 0), bv.get(key, 0))
-    return {
-        "family": a.get("family"),
-        "peak_a_bytes": a.get("peak_bytes", 0),
-        "peak_b_bytes": b.get("peak_bytes", 0),
-        "threshold_pct": threshold_pct,
-        "abs_floor_bytes": abs_floor_bytes,
-        "rows": rows,
-        "n_flagged": sum(r["flagged"] for r in rows),
-    }
+        pairs += [(kind, key, av.get(key, 0), bv.get(key, 0))
+                  for key in sorted(set(av) | set(bv))]
+    d = diffgate.build_diff(a.get("family"), pairs, threshold_pct,
+                            abs_floor_bytes, unit="bytes", ndigits=None)
+    d["peak_a_bytes"] = a.get("peak_bytes", 0)
+    d["peak_b_bytes"] = b.get("peak_bytes", 0)
+    return d
 
 
 # ---------------------------------------------------------------------------

@@ -550,7 +550,7 @@ STEPS: tuple[StepSpec, ...] = (
 # registry's tiny shapes (the jaxpr structure, not the widths, is what
 # regresses: an undropped stash, an undonated copy, a residual that
 # should have been recomputed). Only a small set declares one: each check
-# COMPILES the family, and lint's whole run is contractually ~10 s.
+# COMPILES the family, and lint's whole run must stay under a minute.
 HBM_BUDGET_BYTES: dict[str, int] = {
     "train_single": 48 << 20,   # analyzed peak ~11.4 MB
     "train_tp": 8 << 20,        # analyzed peak ~1.5 MB
@@ -567,3 +567,21 @@ HBM_BUDGET_BYTES: dict[str, int] = {
     # but not on serve_engine means the larger slot batch, not the step,
     # grew — the kv split (mem_cli) says whether shared or private did
 }
+
+
+# Families whose compiled-module collective census graft-lint reconciles
+# against the lint contract through schedkit (contracts.
+# check_collective_count_consistency) — and, where the contract declares
+# ``collective_slack_floor_ms``, whose per-kind slack pools it gates
+# (contracts.check_collective_slack). An allowlist, not "every family
+# that declares collectives", for the same reason HBM_BUDGET_BYTES is
+# one: each census COMPILES the family (profile_family_cached shares
+# the compile between the two rules) and lint's whole run must stay
+# under a minute. The six cover every sharding mechanism once:
+# pure-shard_map dp/sp/ep (exact census), GSPMD tp/tp_sp (superset
+# census), and the zero-collective serving dp step via serve_tp's
+# Megatron pair.
+SCHED_CENSUS_FAMILIES: frozenset[str] = frozenset({
+    "train_dp_bucketed", "train_sp", "train_tp", "train_tp_sp",
+    "train_ep_a2a", "serve_tp",
+})

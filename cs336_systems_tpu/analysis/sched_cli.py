@@ -1,0 +1,116 @@
+"""sched_cli: static schedule/critical-path analysis for any registered
+step.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \\
+        python -m cs336_systems_tpu.analysis.sched_cli --step train_tp
+
+Compiles the named step family (the same bundles mem_cli analyzes — the
+17 tracekit train/serve programs plus the headline/decode/MoE bench
+shapes) on the current backend — the hermetic 8-virtual-device CPU mesh
+by default, a real TPU with ``CS336_TPU_SCHED=1`` — and writes a
+SchedProfile JSON (``schedprofile/v1``): the analytic critical-path
+length and its phase × class composition, the schedule-efficiency ratio
+(critical path ÷ serialized sum), the per-collective SLACK table
+(dependence-independent compute each collective could hide behind), and
+the predicted exposed-collective lower bound. Pure compile-time
+analysis: nothing executes and no device memory is touched.
+
+``--diff a.json b.json`` prints the per-metric deltas through the shared
+dual noise gate (``analysis/diffgate.py``) and exits 1 on any flagged
+row. schedprofiles are DETERMINISTIC (analytic costs, no timing), so
+the default floors are tiny: a self-diff is exactly zero and any flag
+means the program's schedule structure actually changed.
+
+Exit status: 0 ok, 1 findings (flagged diff rows / profile failure),
+2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU mesh BEFORE any backend initializes (same escape
+# hatch as trace_cli/mem_cli): analyzing against a real TPU backend goes
+# through CS336_TPU_SCHED=1, everything else must not grab the tunneled
+# chip.
+if not os.environ.get("CS336_TPU_SCHED"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import sys
+
+import jax
+
+if not os.environ.get("CS336_TPU_SCHED"):
+    jax.config.update("jax_platforms", "cpu")
+
+from cs336_systems_tpu.analysis import diffgate, schedkit
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cs336_systems_tpu.analysis.sched_cli",
+        description="static critical-path/slack analysis of the "
+                    "compiled schedule (see analysis/README.md)")
+    ap.add_argument("--step", metavar="FAMILY",
+                    help="step family to analyze (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list analyzable step families and exit")
+    ap.add_argument("--out", metavar="PATH",
+                    help="SchedProfile JSON path "
+                         "(default <family>.schedprofile.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print JSON to stdout instead of the human "
+                         "summary")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="diff two SchedProfiles of the same family")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="diff flag threshold in %% (default 10)")
+    ap.add_argument("--abs-floor-us", type=float, default=1e-3,
+                    help="diff flag absolute floor in µs (default 0.001 "
+                         "— analytic profiles are deterministic)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in schedkit.family_names():
+            print(name)
+        return 0
+
+    if args.diff:
+        d = schedkit.diff_schedprofiles(
+            _load(args.diff[0]), _load(args.diff[1]),
+            threshold_pct=args.threshold,
+            abs_floor_ms=args.abs_floor_us * 1e-3)
+        print(json.dumps(d, indent=2) if args.json
+              else schedkit.format_diff(d))
+        return diffgate.exit_code(d)
+
+    if not args.step:
+        ap.error("one of --step, --list or --diff is required")
+    try:
+        profile = schedkit.profile_family(args.step)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 1
+    out = args.out or f"{args.step}.schedprofile.json"
+    schedkit.write_profile(profile, out)
+    if args.json:
+        print(json.dumps(profile, indent=2))
+    else:
+        print(schedkit.format_profile(profile))
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

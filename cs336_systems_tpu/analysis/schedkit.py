@@ -1,0 +1,607 @@
+"""schedkit: static dependence/critical-path analysis of scheduled HLO.
+
+tracekit (ISSUE 12) made the compute/collective overlap split a MEASURED
+artifact: per phase, how many device-ms of collective time were hidden
+behind concurrent compute vs exposed as serialization the step paid.
+This module is the STATIC half the ROADMAP's overlap item needs: before
+anyone writes double-buffered collective-permutes, prove from the
+program alone whether XLA's scheduler even has independent compute
+available to hide each psum/a2a behind (T3 and Triton-distributed in
+PAPERS.md attack exactly this serialization on GPUs).
+
+What it does, per registered step family (the same families memkit
+drives — tracekit's 17 train/serve programs plus the bench shapes):
+
+- lowers + compiles the step (abstract args fine — compile-time only),
+- reconstructs the TRUE dependence DAG of every computation in the
+  post-scheduling optimized HLO from the shared parse
+  (``analysis/hlo.py``): operand edges plus ``control-predecessors``
+  scheduling edges; ``while``/``conditional``/``call`` bodies recurse,
+  ``fusion`` stays a leaf kernel,
+- assigns each op an ANALYTIC cost from a per-op model (below),
+- derives the critical-path length and its phase × class composition
+  (same ``named_scope`` attribution as tracekit), a per-collective
+  SLACK table, a predicted exposed-collective lower bound, and the
+  schedule-efficiency ratio critical-path ÷ serialized-sum,
+- emits a canonical ``schedprofile/v1`` JSON, diffable through the
+  shared dual noise gate (``analysis/diffgate.py``) — fully
+  deterministic: same module text, same profile, so a self-diff is
+  exactly zero and a committed baseline diffs bit-stable.
+
+Cost model (constants + provenance in ``COST_MODEL``; absolute numbers
+are v5e nameplate rates, so treat profiles as RELATIVE schedule
+structure — orderings, ratios, slack vs cost — not wall predictions):
+
+- ``dot`` ops: exact MAC count from the dot dimension numbers in the
+  module text (2·out_elems·K FLOPs; K = product of the lhs contracting
+  dims) at the chip's peak MXU rate — ``analysis/flops.py``'s
+  ``V5E_BF16_PEAK_FLOPS``, halved for fp32 operands (the same
+  convention as tracekit's MFU denominator).
+- fusions / elementwise / copies / DMA / Pallas custom-calls: bytes
+  moved (result + operands) at v5e HBM peak bandwidth (819 GB/s).
+- collectives: a bytes + latency ICI model — a fixed per-collective
+  launch latency plus ring-algorithm bytes over the v5e ICI rate
+  (1600 Gbps/chip => 200 GB/s), with the standard algorithmic factor
+  per kind (all-reduce moves 2(n−1)/n of the buffer, all-gather /
+  reduce-scatter / all-to-all (n−1)/n, collective-permute one hop);
+  n = the replica-group size parsed from the instruction, falling back
+  to the family's device count.
+- ``while``: condition + ONE body iteration (trip counts are dynamic;
+  documented convention, stable under diff). ``conditional``: the most
+  expensive branch. ``parameter``/``constant``/``tuple``/gte/bitcast:
+  free.
+
+SLACK of a collective c (the number the overlap roadmap item needs): the
+summed analytic cost of COMPUTE ops in c's computation that are
+dependence-independent of c — neither ancestors nor descendants in the
+DAG — i.e. work a latency-hiding scheduler could legally run inside c's
+window. Compute = mxu-matmul / pallas-kernel / vpu-elementwise /
+copy-transpose plus container bodies (tracekit's hiding classes: DMA
+and other collectives do not hide a collective). Slack is scoped to the
+collective's own computation: compute in other loop iterations cannot
+overlap across an iteration boundary without software pipelining, which
+is exactly the rewrite this analysis is meant to de-risk. The
+per-collective predicted exposure is ``max(0, cost − slack)`` and the
+step-level ``predicted_exposed_ms`` sums it — a LOWER bound: it lets
+every collective claim all of its independent compute, ignoring that
+two collectives may compete for the same slack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable
+
+from cs336_systems_tpu.analysis import hlo as hlolib
+from cs336_systems_tpu.analysis.flops import V5E_BF16_PEAK_FLOPS
+from cs336_systems_tpu.analysis.tracekit import (
+    HloOp,
+    _CALL_TARGET_RE,
+    classify_op,
+    phase_of,
+)
+
+SCHEMA = "schedprofile/v1"
+
+# Analytic chip constants (v5e nameplate; see README provenance notes).
+MXU_PEAK_FLOPS = V5E_BF16_PEAK_FLOPS   # 197 TF/s bf16 (flops.py)
+FP32_MXU_DERATE = 0.5                  # fp32 dots at half the bf16 rate
+HBM_BYTES_PER_S = 819e9                # v5e HBM bandwidth, 819 GB/s
+ICI_BYTES_PER_S = 200e9                # v5e ICI: 1600 Gbps/chip
+ICI_LATENCY_MS = 1e-3                  # ~1 us launch+hop latency floor
+
+# Ring-algorithm bytes factor per collective kind, as a function of the
+# participating group size n (factor × buffer_bytes / ICI rate).
+_COLL_FACTOR: dict[str, Callable[[int], float]] = {
+    "all-reduce": lambda n: 2.0 * (n - 1) / n,
+    "all-gather": lambda n: (n - 1) / n,
+    "reduce-scatter": lambda n: (n - 1) / n,
+    "all-to-all": lambda n: (n - 1) / n,
+    "collective-permute": lambda n: 1.0,
+    "collective-broadcast": lambda n: (n - 1) / n,
+}
+
+_COMPUTE_CLASSES = ("mxu-matmul", "pallas-kernel", "vpu-elementwise",
+                    "copy-transpose")
+_CONTAINERS = ("while", "conditional", "call")
+_ZERO_COST = {"parameter", "constant", "tuple", "get-tuple-element",
+              "bitcast", "after-all", "partition-id", "replica-id",
+              "infeed", "outfeed"}
+
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9,]*)\}")
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def _op_of(ins) -> HloOp:
+    tgt = _CALL_TARGET_RE.search(ins.attrs)
+    return HloOp(ins.opcode, ins.scope, tgt.group(1) if tgt else "")
+
+
+def collective_kind(opcode: str) -> str | None:
+    for kind in hlolib._COLLECTIVE_OPS:
+        if opcode == kind or opcode == kind + "-start":
+            return kind
+    return None
+
+
+def _group_size(attrs: str, n_devices: int) -> int:
+    """Participating-device count of a collective: the size of its first
+    replica group (``{{0,1,2,3},{4,5,6,7}}`` -> 4) or the iota form's
+    trailing dim (``[2,4]<=[8]`` -> 4); empty/absent groups mean all
+    devices."""
+    m = _GROUPS_RE.search(attrs)
+    if m:
+        ids = [s for s in m.group(1).split(",") if s]
+        if len(ids) > 1:
+            return len(ids)
+    m = _IOTA_GROUPS_RE.search(attrs)
+    if m:
+        size = int(m.group(2))
+        if size > 1:
+            return size
+    return max(n_devices, 2)
+
+
+def _dot_flops(ins, by_name: dict) -> float:
+    """2 × out_elems × K for a dot: exact regardless of batch dims
+    (out = batch ∪ lhs-free ∪ rhs-free; total MACs = out_elems · K)."""
+    out_dims = hlolib.shape_dims(ins.type_str)
+    if out_dims is None:
+        return 0.0
+    out_elems = 1
+    for d in out_dims:
+        out_elems *= d
+    k = 1
+    m = _CONTRACT_RE.search(ins.attrs)
+    lhs = by_name.get(ins.operands[0]) if ins.operands else None
+    lhs_dims = hlolib.shape_dims(lhs.type_str) if lhs is not None else None
+    if m and lhs_dims is not None:
+        for idx in (int(s) for s in m.group(1).split(",") if s):
+            if idx < len(lhs_dims):
+                k *= lhs_dims[idx]
+    return 2.0 * out_elems * k
+
+
+class _CompSched:
+    """Per-computation schedule analysis (memoized in ``_Analyzer``)."""
+
+    __slots__ = ("crit_ms", "serial_ms", "crit_composition",
+                 "collective_rows", "census")
+
+
+class _Analyzer:
+    def __init__(self, comps: dict[str, list], n_devices: int):
+        self.comps = comps
+        self.n_devices = n_devices
+        self.cache: dict[str, _CompSched] = {}
+
+    # -- per-op cost ------------------------------------------------------
+
+    def op_cost_ms(self, ins, by_name: dict) -> float:
+        oc = ins.opcode
+        if oc in _ZERO_COST:
+            return 0.0
+        kind = collective_kind(oc)
+        if kind is not None:
+            n = _group_size(ins.attrs, self.n_devices)
+            factor = _COLL_FACTOR[kind](n)
+            return (ICI_LATENCY_MS
+                    + factor * ins.nbytes / ICI_BYTES_PER_S * 1e3)
+        if oc.endswith("-done") or oc == "async-done":
+            return 0.0  # the -start half carries the transfer
+        if oc in _CONTAINERS and ins.called:
+            if oc == "while":
+                return sum(self.analyze(c).crit_ms for c in ins.called
+                           if c in self.comps)
+            if oc == "conditional":
+                branches = [self.analyze(c).crit_ms for c in ins.called
+                            if c in self.comps]
+                return max(branches, default=0.0)
+            return sum(self.analyze(c).crit_ms for c in ins.called
+                       if c in self.comps)
+        if oc == "dot":
+            flops = _dot_flops(ins, by_name)
+            peak = MXU_PEAK_FLOPS
+            if "f32" in ins.type_str:
+                peak *= FP32_MXU_DERATE
+            return flops / peak * 1e3
+        # everything else is bandwidth-bound: result + operand bytes at
+        # HBM peak (fusions, elementwise, copies, DMA, Pallas kernels)
+        nbytes = ins.nbytes
+        for o in ins.operands:
+            src = by_name.get(o)
+            if src is not None:
+                nbytes += src.nbytes
+        return nbytes / HBM_BYTES_PER_S * 1e3
+
+    # -- per-computation analysis ----------------------------------------
+
+    def analyze(self, name: str) -> _CompSched:
+        if name in self.cache:
+            return self.cache[name]
+        sched = _CompSched()
+        sched.crit_ms = 0.0
+        sched.serial_ms = 0.0
+        sched.crit_composition = {}
+        sched.collective_rows = []
+        sched.census = {}
+        self.cache[name] = sched  # cycle guard
+        instrs = self.comps.get(name) or []
+        if not instrs:
+            return sched
+        by_name = {i.name: i for i in instrs}
+        idx = {i.name: n for n, i in enumerate(instrs)}
+
+        costs = [self.op_cost_ms(i, by_name) for i in instrs]
+        serial = 0.0
+        for i, ins in enumerate(instrs):
+            if ins.opcode in _CONTAINERS and ins.called:
+                body = sum(self.analyze(c).serial_ms for c in ins.called
+                           if c in self.comps)
+                serial += body if body else costs[i]
+            else:
+                serial += costs[i]
+
+        preds: list[list[int]] = [[] for _ in instrs]
+        succs: list[list[int]] = [[] for _ in instrs]
+        for i, ins in enumerate(instrs):
+            deps = set(ins.operands) | set(hlolib.control_predecessors(ins))
+            for o in deps:
+                j = idx.get(o)
+                if j is not None and j != i:
+                    preds[i].append(j)
+                    succs[j].append(i)
+
+        # forward DP over the schedule (operands precede users in a
+        # scheduled module, so one pass suffices)
+        finish = [0.0] * len(instrs)
+        argmax_pred = [-1] * len(instrs)
+        for i in range(len(instrs)):
+            best, who = 0.0, -1
+            for j in preds[i]:
+                if finish[j] > best:
+                    best, who = finish[j], j
+            finish[i] = best + costs[i]
+            argmax_pred[i] = who
+        crit_end = max(range(len(instrs)), key=lambda i: finish[i])
+        sched.crit_ms = finish[crit_end]
+        sched.serial_ms = serial
+
+        # walk the critical path back, attributing phase × class; a
+        # container on the path contributes its CALLEE's composition so
+        # the composition always sums to the critical-path total
+        comp: dict[str, dict[str, float]] = {}
+
+        def _merge(dst, src, scale=1.0):
+            for ph, classes in src.items():
+                row = dst.setdefault(ph, {})
+                for cl, v in classes.items():
+                    row[cl] = row.get(cl, 0.0) + v * scale
+
+        i = crit_end
+        while i >= 0:
+            ins = instrs[i]
+            if ins.opcode in _CONTAINERS and ins.called and costs[i] > 0:
+                if ins.opcode == "conditional":
+                    called = max(
+                        (c for c in ins.called if c in self.comps),
+                        key=lambda c: self.analyze(c).crit_ms,
+                        default=None)
+                    called = [called] if called else []
+                else:
+                    called = [c for c in ins.called if c in self.comps]
+                for c in called:
+                    _merge(comp, self.analyze(c).crit_composition)
+            elif costs[i] > 0:
+                ph = phase_of(ins.scope)
+                cl = classify_op(_op_of(ins))
+                comp.setdefault(ph, {})[cl] = (
+                    comp.get(ph, {}).get(cl, 0.0) + costs[i])
+            i = argmax_pred[i]
+        sched.crit_composition = comp
+
+        # collective census + slack within this computation
+        coll_ids = [i for i, ins in enumerate(instrs)
+                    if collective_kind(ins.opcode)]
+        for i in coll_ids:
+            kind = collective_kind(instrs[i].opcode)
+            sched.census[kind] = sched.census.get(kind, 0) + 1
+        if coll_ids:
+            is_compute = []
+            for i, ins in enumerate(instrs):
+                if collective_kind(ins.opcode):
+                    is_compute.append(False)
+                elif ins.opcode in _CONTAINERS and ins.called:
+                    is_compute.append(True)  # loop bodies are compute
+                else:
+                    is_compute.append(
+                        classify_op(_op_of(ins)) in _COMPUTE_CLASSES)
+            for c in coll_ids:
+                related = self._reach(c, preds) | self._reach(c, succs)
+                slack = sum(costs[i] for i in range(len(instrs))
+                            if i != c and i not in related
+                            and is_compute[i] and costs[i] > 0)
+                ins = instrs[c]
+                cost = costs[c]
+                sched.collective_rows.append({
+                    "op": ins.name,
+                    "kind": collective_kind(ins.opcode),
+                    "phase": phase_of(ins.scope),
+                    "computation": name,
+                    "bytes": ins.nbytes,
+                    "cost_ms": round(cost, 6),
+                    "slack_ms": round(slack, 6),
+                    "exposed_ms": round(max(0.0, cost - slack), 6),
+                })
+        return sched
+
+    @staticmethod
+    def _reach(start: int, adj: list[list[int]]) -> set[int]:
+        seen: set[int] = set()
+        stack = list(adj[start])
+        while stack:
+            i = stack.pop()
+            if i in seen:
+                continue
+            seen.add(i)
+            stack.extend(adj[i])
+        return seen
+
+
+# ---------------------------------------------------------------------------
+# Profiling
+
+
+def analyze_hlo_schedule(hlo_text: str, n_devices: int = 1) -> _Analyzer:
+    """Parse + analyze every computation; returns the analyzer with the
+    memoized per-computation results (entry under ``entry_name``)."""
+    comps, entry = hlolib.parse_module(hlo_text)
+    a = _Analyzer(comps, n_devices)
+    a.entry = entry  # type: ignore[attr-defined]
+    for name in comps:
+        a.analyze(name)
+    return a
+
+
+def profile_hlo(hlo_text: str, *, family: str = "custom",
+                n_devices: int = 1, backend: str = "") -> dict:
+    """schedprofile/v1 dict from optimized scheduled HLO text alone.
+
+    The artifact carries TWO collective censuses of the same module:
+    ``collectives`` from schedkit's own DAG walk (entry-reachable
+    computations, fusion bodies excluded) and ``op_map_census`` from
+    tracekit's independent instruction-map parser. The
+    collective-count-consistency lint rule asserts they agree — the
+    anti-drift tripwire between the two analyzers."""
+    from cs336_systems_tpu.analysis import tracekit
+
+    a = analyze_hlo_schedule(hlo_text, n_devices)
+    entry = a.entry  # type: ignore[attr-defined]
+    top = a.analyze(entry)
+
+    census: dict[str, int] = {}
+    rows: list[dict] = []
+    reached = _reachable_comps(a.comps, entry)
+    for name in reached:
+        s = a.analyze(name)
+        for k, v in s.census.items():
+            census[k] = census.get(k, 0) + v
+        rows.extend(s.collective_rows)
+    rows.sort(key=lambda r: -r["cost_ms"])
+
+    phase_ms = {ph: round(sum(cl.values()), 6)
+                for ph, cl in top.crit_composition.items()}
+    class_ms: dict[str, float] = {}
+    for cl_row in top.crit_composition.values():
+        for cl, v in cl_row.items():
+            class_ms[cl] = class_ms.get(cl, 0.0) + v
+    class_ms = {k: round(v, 6) for k, v in class_ms.items()}
+    coll_cost = sum(r["cost_ms"] for r in rows)
+    exposed = sum(r["exposed_ms"] for r in rows)
+    crit = round(top.crit_ms, 6)
+    serial = round(top.serial_ms, 6)
+    return {
+        "schema": SCHEMA,
+        "family": family,
+        "backend": backend,
+        "n_devices": n_devices,
+        "critical_path_ms": crit,
+        "serialized_ms": serial,
+        "schedule_efficiency": round(crit / serial, 4) if serial else 1.0,
+        "critical_path_phase_class_ms": {
+            ph: {cl: round(v, 6) for cl, v in cls.items()}
+            for ph, cls in top.crit_composition.items()},
+        "critical_path_phase_ms": dict(
+            sorted(phase_ms.items(), key=lambda kv: -kv[1])),
+        "critical_path_class_ms": dict(
+            sorted(class_ms.items(), key=lambda kv: -kv[1])),
+        "collectives": dict(sorted(census.items())),
+        "op_map_census": dict(sorted(tracekit.count_collectives(
+            tracekit.parse_hlo_ops(hlo_text)).items())),
+        "collective_cost_ms": round(coll_cost, 6),
+        "predicted_exposed_ms": round(exposed, 6),
+        "collective_rows": rows,
+        "model": {
+            "mxu_peak_flops": MXU_PEAK_FLOPS,
+            "fp32_mxu_derate": FP32_MXU_DERATE,
+            "hbm_bytes_per_s": HBM_BYTES_PER_S,
+            "ici_bytes_per_s": ICI_BYTES_PER_S,
+            "ici_latency_ms": ICI_LATENCY_MS,
+        },
+    }
+
+
+def _reachable_comps(comps: dict[str, list], entry: str) -> list[str]:
+    """Computations reachable from the entry through called computations
+    EXCLUDING fusion bodies (fusions are leaf kernels — their internal
+    instructions never execute as schedule slots, so their 'collectives'
+    could only be parse artifacts and must not enter the census)."""
+    seen: list[str] = []
+    stack = [entry]
+    visited = set()
+    while stack:
+        name = stack.pop()
+        if name in visited or name not in comps:
+            continue
+        visited.add(name)
+        seen.append(name)
+        for ins in comps[name]:
+            if ins.opcode != "fusion":
+                stack.extend(ins.called)
+    return seen
+
+
+def profile_callable(fn: Callable, args: tuple, *,
+                     family: str = "custom", n_devices: int = 1) -> dict:
+    """Compile ``fn(*args)`` (abstract args fine) and analyze the
+    schedule of its optimized HLO. No execution, no device memory."""
+    import jax
+
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    hlo_text = jfn.lower(*args).compile().as_text()
+    return profile_hlo(hlo_text, family=family, n_devices=n_devices,
+                       backend=jax.default_backend())
+
+
+def family_names() -> list[str]:
+    from cs336_systems_tpu.analysis import memkit
+
+    return memkit.family_names()
+
+
+def profile_family(family: str) -> dict:
+    """Build a registered family's bundle and analyze its schedule."""
+    from cs336_systems_tpu.analysis import memkit
+
+    fn, args, _leaf_classes, n_dev = memkit._build_family(family)
+    return profile_callable(fn, args, family=family, n_devices=n_dev)
+
+
+_FAMILY_CACHE: dict[str, dict] = {}
+
+
+def profile_family_cached(family: str) -> dict:
+    """Memoized ``profile_family`` — the two lint rules share one
+    compile per family within a lint run. Tests may clear
+    ``_FAMILY_CACHE`` to force recompilation."""
+    if family not in _FAMILY_CACHE:
+        _FAMILY_CACHE[family] = profile_family(family)
+    return _FAMILY_CACHE[family]
+
+
+# ---------------------------------------------------------------------------
+# Diffing: the same dual noise gate as every other kit. schedprofiles
+# are DETERMINISTIC analytic artifacts (no timing jitter): the floors
+# only absorb compiler-version scheduling drift, so they sit far lower
+# than tracekit's device-lane gate.
+
+
+def diff_schedprofiles(a: dict, b: dict, threshold_pct: float = 10.0,
+                       abs_floor_ms: float = 1e-6) -> dict:
+    from cs336_systems_tpu.analysis import diffgate
+
+    diffgate.check_same_family(a, b)
+    pairs = [
+        ("total", "critical_path_ms",
+         a.get("critical_path_ms", 0.0), b.get("critical_path_ms", 0.0)),
+        ("total", "serialized_ms",
+         a.get("serialized_ms", 0.0), b.get("serialized_ms", 0.0)),
+        ("total", "collective_cost_ms",
+         a.get("collective_cost_ms", 0.0), b.get("collective_cost_ms", 0.0)),
+        ("total", "predicted_exposed_ms",
+         a.get("predicted_exposed_ms", 0.0),
+         b.get("predicted_exposed_ms", 0.0)),
+    ]
+    for kind, field in (("phase", "critical_path_phase_ms"),
+                        ("class", "critical_path_class_ms")):
+        av, bv = a.get(field, {}), b.get(field, {})
+        pairs += [(kind, key, av.get(key, 0.0), bv.get(key, 0.0))
+                  for key in sorted(set(av) | set(bv))]
+
+    def _slack_by_kind(p):
+        out: dict[str, float] = {}
+        for r in p.get("collective_rows", []):
+            out[r["kind"]] = out.get(r["kind"], 0.0) + r["slack_ms"]
+        return out
+
+    av, bv = _slack_by_kind(a), _slack_by_kind(b)
+    pairs += [("slack", key, av.get(key, 0.0), bv.get(key, 0.0))
+              for key in sorted(set(av) | set(bv))]
+    d = diffgate.build_diff(a.get("family"), pairs, threshold_pct,
+                            abs_floor_ms, unit="ms", ndigits=6)
+    d["efficiency_a"] = a.get("schedule_efficiency")
+    d["efficiency_b"] = b.get("schedule_efficiency")
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:.3f} us" if v < 0.1 else f"{v:.3f} ms"
+
+
+def format_profile(p: dict) -> str:
+    lines = [
+        f"SchedProfile {p['family']}  backend={p['backend']} "
+        f"devices={p['n_devices']}",
+        f"  critical path {_fmt_ms(p['critical_path_ms'])}   "
+        f"serialized {_fmt_ms(p['serialized_ms'])}   "
+        f"efficiency {p['schedule_efficiency']:.3f}",
+    ]
+    if p.get("collectives"):
+        cs = ", ".join(f"{k}×{v}"
+                       for k, v in sorted(p["collectives"].items()))
+        lines.append(
+            f"  collectives: {cs}   analytic cost "
+            f"{_fmt_ms(p['collective_cost_ms'])}   predicted exposed "
+            f"≥ {_fmt_ms(p['predicted_exposed_ms'])}")
+    lines.append("  critical-path phase × class:")
+    pcs = p.get("critical_path_phase_class_ms", {})
+    for ph in sorted(p.get("critical_path_phase_ms", {}),
+                     key=lambda x: -p["critical_path_phase_ms"][x]):
+        cells = pcs.get(ph, {})
+        detail = "  ".join(f"{c}={_fmt_ms(cells[c])}"
+                           for c in sorted(cells, key=lambda c: -cells[c]))
+        lines.append(f"    {ph:<10} {_fmt_ms(p['critical_path_phase_ms'][ph]):>12}"
+                     f"   {detail}")
+    rows = p.get("collective_rows", [])
+    if rows:
+        lines.append("  slack table (top collectives by analytic cost):")
+        for r in rows[:12]:
+            lines.append(
+                f"    {r['kind']:<19} {r['phase']:<9} "
+                f"cost {_fmt_ms(r['cost_ms']):>11}  slack "
+                f"{_fmt_ms(r['slack_ms']):>11}  exposed "
+                f"{_fmt_ms(r['exposed_ms']):>11}  {r['op']}")
+    return "\n".join(lines)
+
+
+def format_diff(d: dict) -> str:
+    lines = [
+        f"diff [{d['family']}]  efficiency {d.get('efficiency_a')} -> "
+        f"{d.get('efficiency_b')}   threshold ±{d['threshold_pct']}% & "
+        f">{d['abs_floor_ms']} ms",
+    ]
+    for r in d["rows"]:
+        flag = " <-- FLAGGED" if r["flagged"] else ""
+        pct = (f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None
+               else "new")
+        lines.append(
+            f"  {r['kind']:<6} {r['key']:<28} {r['a_ms']:12.6f} -> "
+            f"{r['b_ms']:12.6f}  {r['delta_ms']:+12.6f} ms  {pct:>8}{flag}")
+    lines.append(f"{d['n_flagged']} row(s) above threshold")
+    return "\n".join(lines)
+
+
+def write_profile(p: dict, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(p, f, indent=2)
+        f.write("\n")

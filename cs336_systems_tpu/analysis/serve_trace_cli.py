@@ -113,7 +113,8 @@ def main(argv=None) -> int:
             return 2
         print(json.dumps(d, indent=2) if args.json
               else servetrace.format_diff(d))
-        return 1 if d["n_flagged"] else 0
+        from cs336_systems_tpu.analysis import diffgate
+        return diffgate.exit_code(d)
 
     if not (args.run and args.step):
         ap.error("one of --run --step FAMILY, --list, --report or "

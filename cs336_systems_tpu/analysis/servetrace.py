@@ -331,33 +331,17 @@ def _gate_rows(a: dict, b: dict) -> list[tuple[str, str, float, float]]:
 def diff_servetraces(a: dict, b: dict, threshold_pct: float = 50.0,
                      abs_floor_ms: float = 2.0) -> dict:
     """Component/phase deltas between two servetrace artifacts of the
-    same family. A row FLAGS only when both gates trip: |Δ| >
-    ``abs_floor_ms`` AND |Δ%| > ``threshold_pct`` — host wall times on
-    the CPU mesh jitter tens of percent run to run, hence defaults far
-    looser than tracekit's device-lane gate. Identical artifacts flag
-    nothing."""
-    if a.get("family") != b.get("family"):
-        raise ValueError(
-            f"artifacts are different families: {a.get('family')!r} vs "
-            f"{b.get('family')!r} — deltas would be meaningless")
-    rows = []
-    for kind, key, x, y in _gate_rows(a, b):
-        delta = y - x
-        pct = (delta / x * 100.0) if x else (float("inf") if y else 0.0)
-        rows.append({
-            "kind": kind, "key": key, "a_ms": x, "b_ms": y,
-            "delta_ms": round(delta, 4),
-            "delta_pct": round(pct, 1) if pct != float("inf") else None,
-            "flagged": abs(delta) > abs_floor_ms
-            and (x == 0 or abs(pct) > threshold_pct),
-        })
-    return {
-        "family": a.get("family"),
-        "threshold_pct": threshold_pct,
-        "abs_floor_ms": abs_floor_ms,
-        "rows": rows,
-        "n_flagged": sum(r["flagged"] for r in rows),
-    }
+    same family, through the shared dual noise gate
+    (``analysis/diffgate.py``): a row FLAGS only when both gates trip,
+    |Δ| > ``abs_floor_ms`` AND |Δ%| > ``threshold_pct`` — host wall
+    times on the CPU mesh jitter tens of percent run to run, hence
+    defaults far looser than tracekit's device-lane gate. Identical
+    artifacts flag nothing."""
+    from cs336_systems_tpu.analysis import diffgate
+
+    diffgate.check_same_family(a, b, noun="artifacts")
+    return diffgate.build_diff(a.get("family"), _gate_rows(a, b),
+                               threshold_pct, abs_floor_ms, unit="ms")
 
 
 # ---------------------------------------------------------------------------

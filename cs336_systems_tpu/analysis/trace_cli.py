@@ -83,7 +83,8 @@ def main(argv=None) -> int:
                                    abs_floor_ms=args.abs_floor_ms)
         print(json.dumps(d, indent=2) if args.json
               else tracekit.format_diff(d))
-        return 1 if d["n_flagged"] else 0
+        from cs336_systems_tpu.analysis import diffgate
+        return diffgate.exit_code(d)
 
     if not args.step:
         ap.error("one of --step, --list or --diff is required")

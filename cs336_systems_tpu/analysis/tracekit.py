@@ -755,16 +755,16 @@ def diff_profiles(a: dict, b: dict, threshold_pct: float = 10.0,
                   abs_floor_ms: float = 0.05) -> dict:
     """Per-phase and per-class deltas between two StepProfiles.
 
-    A row is FLAGGED only when BOTH gates trip: |Δ| > ``abs_floor_ms``
-    (device-lane timings jitter by tens of µs run to run — a 40 µs swing
-    on a 50 µs phase is noise, not a 80% regression) and |Δ%| >
-    ``threshold_pct`` of the baseline. Identical runs flag nothing.
+    The dual noise gate lives in ``analysis/diffgate.py`` (shared with
+    mem_cli/serve_trace_cli/sched_cli): a row is FLAGGED only when BOTH
+    gates trip, |Δ| > ``abs_floor_ms`` (device-lane timings jitter by
+    tens of µs run to run — a 40 µs swing on a 50 µs phase is noise, not
+    a 80% regression) and |Δ%| > ``threshold_pct`` of the baseline.
+    Identical runs flag nothing.
     """
-    if a.get("family") != b.get("family"):
-        raise ValueError(
-            f"profiles are different families: {a.get('family')!r} vs "
-            f"{b.get('family')!r} — deltas would be meaningless")
-    rows = []
+    from cs336_systems_tpu.analysis import diffgate
+
+    diffgate.check_same_family(a, b)
     # Overlap rows (ISSUE 12): hidden/exposed collective splits diff
     # like any phase row; profiles written before the fields existed
     # contribute 0.0 so old artifacts keep diffing cleanly.
@@ -777,31 +777,17 @@ def diff_profiles(a: dict, b: dict, threshold_pct: float = 10.0,
          {"collective-hidden": b.get("collective_hidden_ms", 0.0),
           "collective-exposed": b.get("collective_exposed_ms", 0.0)}),
     ]
-    for kind, av, bv in sections:
-        for key in sorted(set(av) | set(bv)):
-            x, y = av.get(key, 0.0), bv.get(key, 0.0)
-            delta = y - x
-            pct = (delta / x * 100.0) if x else (float("inf") if y else 0.0)
-            rows.append({
-                "kind": kind, "key": key,
-                "a_ms": x, "b_ms": y,
-                "delta_ms": round(delta, 4),
-                "delta_pct": round(pct, 1) if pct != float("inf") else None,
-                "flagged": abs(delta) > abs_floor_ms
-                and (x == 0 or abs(pct) > threshold_pct),
-            })
+    pairs = [(kind, key, av.get(key, 0.0), bv.get(key, 0.0))
+             for kind, av, bv in sections
+             for key in sorted(set(av) | set(bv))]
+    d = diffgate.build_diff(a.get("family"), pairs, threshold_pct,
+                            abs_floor_ms, unit="ms")
     ta = a.get("total_device_ms_per_step", 0.0)
     tb = b.get("total_device_ms_per_step", 0.0)
-    return {
-        "family": a.get("family"),
-        "total_a_ms": ta,
-        "total_b_ms": tb,
-        "total_delta_ms": round(tb - ta, 4),
-        "threshold_pct": threshold_pct,
-        "abs_floor_ms": abs_floor_ms,
-        "rows": rows,
-        "n_flagged": sum(r["flagged"] for r in rows),
-    }
+    d["total_a_ms"] = ta
+    d["total_b_ms"] = tb
+    d["total_delta_ms"] = round(tb - ta, 4)
+    return d
 
 
 # ---------------------------------------------------------------------------

@@ -249,6 +249,19 @@ def lint_contract(cfg: TransformerConfig, n_token_axes: int = 2) -> dict:
         },
         "barriers": 2 * L if not cfg.scan_layers else 0,
         "grad_reduction": {"axes": token_axes, "count": n_sync},
+        # Pure shard_map lowering: schedkit's compiled-module census must
+        # match the counts above EXACTLY (no gspmd_collectives flag).
+        # Slack floors ~4x below the measured pools (all-reduce 0.108,
+        # all-to-all 0.010, all-gather 0.008 ms on the registry's tiny
+        # CPU-mesh shapes): the dispatch a2as and routing gathers are
+        # latency-chained by construction, so their pools are small but
+        # must not collapse to zero — that is the "every token waited on
+        # one expert's row gather" serialization.
+        "collective_slack_floor_ms": {
+            "all-reduce": 0.02,
+            "all-to-all": 0.002,
+            "all-gather": 0.002,
+        },
         "note": "ep[a2a]: 5 a2a + k·axes gathers per MoE layer; 3 psums "
                 f"per layer + {3 + n_sync} step-level (loss pmean + its "
                 "transpose + grad-norm + grad-sync)",

@@ -113,12 +113,28 @@ def lint_contract(cfg: TransformerConfig | None = None,
     if cfg is not None and cfg.ce_chunk_size == 0:
         return {
             "collectives": {},
+            "gspmd_collectives": True,
             "note": "tp (GSPMD, full-logits CE): collectives are "
                     "compile-time-inserted, none may appear in the jaxpr",
         }
     psum = 4 if have_dp else 2
     return {
         "collectives": {"psum": psum},
+        # GSPMD inserts collectives beyond the declared shard_map sites,
+        # so schedkit's compiled-module census is gated as a SUPERSET of
+        # the jaxpr counts (contracts.check_collective_count_consistency),
+        # not an exact match like the pure-shard_map families.
+        "gspmd_collectives": True,
+        # Per-kind slack floors (ms, summed over the kind's collectives)
+        # for contracts.check_collective_slack: ~4x below the pools
+        # schedkit measures on the registry's tiny 8-device CPU mesh
+        # (all-reduce 0.097, all-gather 0.035), so scheduling drift
+        # passes but a structural serialization — e.g. chaining every
+        # grad through one psum's result — trips the rule.
+        "collective_slack_floor_ms": {
+            "all-reduce": 0.02,
+            "all-gather": 0.008,
+        },
         "note": "tp (GSPMD) + chunked-CE island: 1 vocab psum pair per "
                 "chunk fwd/bwd (scan body counts once) + loss/dW psums "
                 "over dp; all other collectives compile-time-inserted",

@@ -51,6 +51,40 @@ if [ "$mem_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$mem_status
 
+# schedkit gate (ISSUE 13): the static dependence/critical-path analyzer
+# end to end on the three families whose contracts lean on it — train_tp
+# (GSPMD collectives + slack floors), train_ep_a2a (shard_map a2a + the
+# gradsan-twin grad sync) and serve_engine_prefix (decode-only collective
+# contract). Each schedprofile must build (composition sums and the
+# census/op_map cross-check are asserted inside profile_hlo) and
+# self-diff to exit 0; the fresh train_tp artifact is then diffed against
+# the committed baseline in results/schedprofiles/ — the analytic model
+# is deterministic, so ANY delta is real drift (cost model, parser, or
+# the step's actual HLO) and must be triaged, not absorbed.
+sched_status=0
+for fam in train_tp train_ep_a2a serve_engine_prefix; do
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.sched_cli --step "$fam" \
+        --out "/tmp/sched_$fam.schedprofile.json" \
+        || { sched_status=$?; echo "schedkit: $fam FAILED" >&2; }
+    if [ "$sched_status" -eq 0 ]; then
+        JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m cs336_systems_tpu.analysis.sched_cli \
+            --diff "/tmp/sched_$fam.schedprofile.json" \
+                   "/tmp/sched_$fam.schedprofile.json" \
+            || { sched_status=$?
+                 echo "schedkit: $fam self-diff FAILED" >&2; }
+    fi
+done
+if [ "$sched_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.sched_cli \
+        --diff results/schedprofiles/train_tp.schedprofile.json \
+               /tmp/sched_train_tp.schedprofile.json
+    sched_status=$?
+fi
+[ "$status" -eq 0 ] && status=$sched_status
+
 # paged-serving gate: the skewed ragged family through BOTH analysis
 # pipelines — a traced StepProfile (phase attribution must see the paged
 # kv-update scopes) and an analyzed memprofile under the family's HBM

@@ -1,0 +1,98 @@
+"""Doc-drift tripwire (ISSUE 13 satellite): the family/target counts the
+docs CLAIM must match what the CLIs actually register. CLAUDE.md and
+analysis/README.md both say "--list is the source of truth" — this test
+makes that sentence enforceable: every numeric count printed next to a
+--list mention is parsed out of the doc text and asserted against the
+live registry, so adding a family without touching the docs (or
+vice-versa) fails here instead of rotting silently.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CLAUDE_MD = (REPO / "CLAUDE.md").read_text()
+README = (REPO / "cs336_systems_tpu" / "analysis" / "README.md").read_text()
+
+
+def _trace_families():
+    from cs336_systems_tpu.analysis import tracekit
+
+    return list(tracekit.FAMILIES)
+
+
+def _mem_targets():
+    from cs336_systems_tpu.analysis import memkit
+
+    return memkit.family_names()
+
+
+def test_claude_md_tracekit_family_count():
+    # "# family (17: train single/..." in the tracekit block
+    m = re.search(r"family \((\d+): train single", CLAUDE_MD)
+    assert m, "CLAUDE.md tracekit block lost its family-count claim"
+    assert int(m.group(1)) == len(_trace_families())
+
+
+def test_claude_md_memkit_target_count():
+    # "...bench shapes (21 targets; --list is the source of" (memkit)
+    m = re.search(r"\((\d+) targets; --list is the source", CLAUDE_MD)
+    assert m, "CLAUDE.md memkit block lost its target-count claim"
+    assert int(m.group(1)) == len(_mem_targets())
+
+
+def test_claude_md_schedkit_target_count():
+    # "# schedkit: ... for the same 21\n# targets"
+    m = re.search(r"schedkit: static dependence/critical-path analysis "
+                  r"for the same (\d+)\n# targets", CLAUDE_MD)
+    assert m, "CLAUDE.md schedkit block lost its target-count claim"
+    from cs336_systems_tpu.analysis import schedkit
+
+    assert int(m.group(1)) == len(schedkit.family_names())
+
+
+def test_readme_list_count_claims():
+    # every "--list      # N families/targets" comment in analysis/README
+    claims = re.findall(
+        r"analysis\.(\w+) --list\s+# (\d+) (?:families|targets)", README)
+    assert {c[0] for c in claims} >= {"trace_cli", "mem_cli", "sched_cli"}
+    live = {
+        "trace_cli": len(_trace_families()),
+        "mem_cli": len(_mem_targets()),
+        "sched_cli": len(_mem_targets()),  # schedkit mirrors memkit
+    }
+    for cli, n in claims:
+        if cli in live:
+            assert int(n) == live[cli], (cli, n)
+
+
+def test_lint_registry_matches_serve_and_train_families():
+    # the lint registry = the 17 traced families + the kernel-level
+    # gmm_fused_bwd step (README: "minus the kernel-level gmm_fused_bwd")
+    from cs336_systems_tpu.analysis import registry
+
+    lint_names = {s.name for s in registry.STEPS}
+    assert lint_names == set(_trace_families()) | {"gmm_fused_bwd"}
+
+
+def test_sched_census_allowlist_names_registered_steps():
+    # a renamed/removed family must not leave a dangling allowlist entry
+    # (the lint rule would silently never run for it)
+    from cs336_systems_tpu.analysis import registry
+
+    lint_names = {s.name for s in registry.STEPS}
+    assert registry.SCHED_CENSUS_FAMILIES <= lint_names
+
+
+def test_slack_floor_families_are_census_families():
+    # every family whose contract declares slack floors must be in the
+    # allowlist doc story (tp/tp_sp/ep) and actually declare floors
+    from cs336_systems_tpu.analysis import registry
+    from cs336_systems_tpu.parallel import ep, tp, tp_sp
+
+    for name, contract in (
+            ("tp", tp.lint_contract()),
+            ("tp_sp", tp_sp.lint_contract(registry._tiny_cfg())),
+            ("ep", ep.lint_contract(registry._moe_cfg()))):
+        floors = contract.get("collective_slack_floor_ms")
+        assert floors and all(v > 0 for v in floors.values()), name

@@ -1,0 +1,101 @@
+"""flops.py oracle tests: the shared MFU denominator pinned against
+hand-computed numbers (ISSUE 13 satellite). Every consumer — bench.py's
+headline line, the MoE bench's MFU column, tracekit's achieved-TF/s and
+schedkit's MXU cost model — divides by these conventions, so a silent
+change here skews every artifact that gets compared across rounds. The
+oracles below are worked BY HAND in the comments from the docstring's
+stated convention; if one fails, either the convention changed (update
+the docstring AND these numbers together) or a refactor broke the
+arithmetic.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from cs336_systems_tpu.analysis.flops import (
+    V5E_BF16_PEAK_FLOPS,
+    decode_flops_per_token,
+    model_flops_per_token,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=10_000, context_length=512, d_model=1024,
+                num_layers=24, d_ff=4096, num_experts=0, moe_top_k=0)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_v5e_peak_is_nameplate():
+    assert V5E_BF16_PEAK_FLOPS == 197e12
+
+
+def test_dense_train_flops_hand_computed():
+    # Headline-ish dense config, worked by hand:
+    #   d=1024, dff=4096, L=24, V=10000, S=512
+    #   per-layer param matmuls: 4*d*d (qkvo) + 3*d*dff (SwiGLU)
+    #     = 4*1024*1024 + 3*1024*4096 = 4_194_304 + 12_582_912
+    #     = 16_777_216
+    #   N_matmul = 24 * 16_777_216 + d*V = 402_653_184 + 10_240_000
+    #     = 412_893_184
+    #   attn (causal) = 12*S*d*L*0.5 = 12*512*1024*24/2 = 75_497_472
+    #   total = 6*N_matmul + attn = 2_477_359_104 + 75_497_472
+    #     = 2_552_856_576
+    assert model_flops_per_token(_cfg()) == 2_552_856_576
+
+
+def test_full_attention_doubles_the_causal_term():
+    causal = model_flops_per_token(_cfg(), causal=True)
+    full = model_flops_per_token(_cfg(), causal=False)
+    # full attention scores 12*S*d*L = 150_994_944 per token; causal
+    # counts only the lower triangle, so the delta is the other half
+    assert full - causal == 75_497_472
+
+
+def test_moe_train_flops_counts_topk_experts_and_router():
+    # E=8 experts, top_k=2: a token's FFN work doubles and the router
+    # matmul d*E joins the per-layer params.
+    #   per-layer: 4*d*d + 2*3*d*dff + d*8
+    #     = 4_194_304 + 25_165_824 + 8_192 = 29_368_320
+    #   N_matmul = 24*29_368_320 + 10_240_000 = 715_079_680
+    #   total = 6*N_matmul + 75_497_472 = 4_290_478_080 + 75_497_472
+    #     = 4_365_975_552
+    cfg = _cfg(num_experts=8, moe_top_k=2)
+    assert model_flops_per_token(cfg) == 4_365_975_552
+
+
+def test_moe_top_k_zero_still_counts_one_expert():
+    # max(top_k, 1): a degenerate top_k=0 config must not zero the FFN
+    cfg = _cfg(num_experts=8, moe_top_k=0)
+    dense_plus_router = model_flops_per_token(_cfg()) + 6 * 24 * 1024 * 8
+    assert model_flops_per_token(cfg) == dense_plus_router
+
+
+def test_decode_flops_hand_computed():
+    # Forward only (2*N_matmul) + cached attention 4*attend*d*L.
+    #   N_matmul = 412_893_184 (dense config above)
+    #   attend_len=256: 4*256*1024*24 = 25_165_824
+    #   total = 825_786_368 + 25_165_824 = 850_952_192
+    assert decode_flops_per_token(_cfg(), attend_len=256) == 850_952_192
+
+
+def test_decode_defaults_to_full_context_window():
+    cfg = _cfg()
+    assert decode_flops_per_token(cfg) == decode_flops_per_token(
+        cfg, attend_len=cfg.context_length)
+
+
+def test_ragged_decode_uses_mean_of_lens_not_max():
+    # Per-token share of a ragged batch is the MEAN attended length:
+    # lens [128, 256, 384, 512] -> mean 320, NOT max 512.
+    cfg = _cfg()
+    ragged = decode_flops_per_token(cfg, attend_lens=[128, 256, 384, 512])
+    assert ragged == decode_flops_per_token(cfg, attend_len=320)
+    assert ragged < decode_flops_per_token(cfg, attend_len=512)
+
+
+def test_ragged_and_scalar_are_mutually_exclusive():
+    with pytest.raises(ValueError, match="not both"):
+        decode_flops_per_token(_cfg(), attend_len=256,
+                               attend_lens=[1, 2, 3])

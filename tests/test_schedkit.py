@@ -1,0 +1,466 @@
+"""schedkit tests: critical-path/slack reconstruction against synthetic
+scheduled-HLO fixtures with HAND-COMPUTED answers, the container
+conventions (while = condition + one body iteration), the two lint rules
+(collective-zero-slack / collective-count-consistency) fired by SEEDED
+mutations and quiet on clean inputs, and CPU end-to-end runs on real
+registered families (composition sums to the critical-path total, the
+DAG census agrees with tracekit's independent parse, self-diff is
+exactly zero, the declared slack floors hold on the current tree).
+
+Same oracle discipline as test_memkit.py / test_tracekit.py: every
+modeling rule is pinned by a fixture whose correct answer is derived by
+hand in a comment before the pipeline ever touches a compiled module.
+"""
+
+import json
+
+import pytest
+
+from cs336_systems_tpu.analysis import contracts, schedkit
+from cs336_systems_tpu.analysis.schedkit import (
+    HBM_BYTES_PER_S,
+    ICI_BYTES_PER_S,
+    ICI_LATENCY_MS,
+    MXU_PEAK_FLOPS,
+    analyze_hlo_schedule,
+    diff_schedprofiles,
+    profile_hlo,
+)
+
+TOL = 1e-6  # artifact values are round(x, 6) — half-ulp of that
+
+
+def _ms(nbytes: float) -> float:
+    return nbytes / HBM_BYTES_PER_S * 1e3
+
+
+# --- fixture A: diamond of elementwise ops ---------------------------------
+# f32[262144] = 1 MiB. Every add/multiply reads two distinct 1 MiB
+# operands and writes 1 MiB -> cost = 3 MiB at HBM rate each. a and b depend only
+# on the parameters (free), c on both:
+#   critical path = a->c (or b->c) = 2 * cost
+#   serialized    = 3 * cost
+#   efficiency    = 2/3
+# All ops are scope-less ("other" phase) vpu-elementwise.
+
+_HLO_DIAMOND = """\
+HloModule jit_d, is_scheduled=true, entry_computation_layout={(f32[262144]{0}, f32[262144]{0})->f32[262144]{0}}
+
+ENTRY %main.6 (p0.1: f32[262144], p1.2: f32[262144]) -> f32[262144] {
+  %p0.1 = f32[262144]{0} parameter(0)
+  %p1.2 = f32[262144]{0} parameter(1)
+  %a.3 = f32[262144]{0} add(f32[262144]{0} %p0.1, f32[262144]{0} %p1.2)
+  %b.4 = f32[262144]{0} multiply(f32[262144]{0} %p0.1, f32[262144]{0} %p1.2)
+  ROOT %c.5 = f32[262144]{0} add(f32[262144]{0} %a.3, f32[262144]{0} %b.4)
+}
+"""
+
+
+def test_diamond_critical_path_and_efficiency():
+    p = profile_hlo(_HLO_DIAMOND, family="fixture", n_devices=1)
+    cost = _ms(3 << 20)
+    assert p["critical_path_ms"] == pytest.approx(2 * cost, abs=TOL)
+    assert p["serialized_ms"] == pytest.approx(3 * cost, abs=TOL)
+    assert p["schedule_efficiency"] == pytest.approx(2 / 3, abs=1e-4)
+    assert p["collectives"] == {}
+    assert p["predicted_exposed_ms"] == 0.0
+
+
+def test_diamond_composition_sums_to_critical_path():
+    p = profile_hlo(_HLO_DIAMOND, family="fixture", n_devices=1)
+    total = sum(v for cls in p["critical_path_phase_class_ms"].values()
+                for v in cls.values())
+    assert total == pytest.approx(p["critical_path_ms"], abs=1e-5)
+    assert p["critical_path_class_ms"] == pytest.approx(
+        {"vpu-elementwise": 2 * _ms(3 << 20)}, rel=1e-3)
+    assert list(p["critical_path_phase_ms"]) == ["other"]
+
+
+# --- fixture B: collective slack -------------------------------------------
+# The dot and the all-reduce are dependence-independent: the dot is MXU
+# compute the scheduler could legally run inside the all-reduce's window.
+#   dot:  bf16 [128,256] x [256,128] -> 2*(128*128)*256 = 8_388_608 FLOPs
+#         at the full bf16 peak
+#   ar:   1 MiB over an 8-device ring: latency + 2*(8-1)/8 * bytes/rate
+#   slack(ar) = cost(dot); exposed(ar) = cost(ar) - cost(dot)
+
+_HLO_COLL = """\
+HloModule jit_c, is_scheduled=true
+
+%red.add (x.1: f32[], y.1: f32[]) -> f32[] {
+  %x.1 = f32[] parameter(0)
+  %y.1 = f32[] parameter(1)
+  ROOT %r.1 = f32[] add(f32[] %x.1, f32[] %y.1)
+}
+
+ENTRY %main.7 (p0.1: bf16[128,256], p1.2: bf16[256,128], p2.3: f32[262144]) -> (bf16[128,128], f32[262144]) {
+  %p0.1 = bf16[128,256]{1,0} parameter(0)
+  %p1.2 = bf16[256,128]{1,0} parameter(1)
+  %p2.3 = f32[262144]{0} parameter(2)
+  %dot.4 = bf16[128,128]{1,0} dot(bf16[128,256]{1,0} %p0.1, bf16[256,128]{1,0} %p1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %ar.5 = f32[262144]{0} all-reduce(f32[262144]{0} %p2.3), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%red.add
+  ROOT %t.6 = (bf16[128,128]{1,0}, f32[262144]{0}) tuple(bf16[128,128]{1,0} %dot.4, f32[262144]{0} %ar.5)
+}
+"""
+
+# The seeded mutation the zero-slack rule exists for: the SAME module
+# with one extra dependence edge — the dot now waits on the all-reduce
+# (a control-predecessor, exactly how an accidental serialization prints
+# in scheduled HLO) — so the collective's slack pool collapses to zero.
+
+_HLO_COLL_SERIALIZED = """\
+HloModule jit_c, is_scheduled=true
+
+%red.add (x.1: f32[], y.1: f32[]) -> f32[] {
+  %x.1 = f32[] parameter(0)
+  %y.1 = f32[] parameter(1)
+  ROOT %r.1 = f32[] add(f32[] %x.1, f32[] %y.1)
+}
+
+ENTRY %main.7 (p0.1: bf16[128,256], p1.2: bf16[256,128], p2.3: f32[262144]) -> (bf16[128,128], f32[262144]) {
+  %p0.1 = bf16[128,256]{1,0} parameter(0)
+  %p1.2 = bf16[256,128]{1,0} parameter(1)
+  %p2.3 = f32[262144]{0} parameter(2)
+  %ar.5 = f32[262144]{0} all-reduce(f32[262144]{0} %p2.3), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%red.add
+  %dot.4 = bf16[128,128]{1,0} dot(bf16[128,256]{1,0} %p0.1, bf16[256,128]{1,0} %p1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, control-predecessors={%ar.5}
+  ROOT %t.6 = (bf16[128,128]{1,0}, f32[262144]{0}) tuple(bf16[128,128]{1,0} %dot.4, f32[262144]{0} %ar.5)
+}
+"""
+
+_DOT_MS = 2 * 128 * 128 * 256 / MXU_PEAK_FLOPS * 1e3
+_AR_MS = ICI_LATENCY_MS + 2 * (8 - 1) / 8 * (1 << 20) / ICI_BYTES_PER_S * 1e3
+
+
+def test_collective_cost_slack_and_exposure():
+    p = profile_hlo(_HLO_COLL, family="fixture", n_devices=8)
+    assert p["collectives"] == {"all-reduce": 1}
+    assert p["op_map_census"] == {"all-reduce": 1}
+    (row,) = p["collective_rows"]
+    assert row["kind"] == "all-reduce" and row["bytes"] == 1 << 20
+    assert row["cost_ms"] == pytest.approx(_AR_MS, abs=1e-6)
+    assert row["slack_ms"] == pytest.approx(_DOT_MS, abs=1e-6)
+    assert row["exposed_ms"] == pytest.approx(_AR_MS - _DOT_MS, abs=1e-6)
+    assert p["predicted_exposed_ms"] == pytest.approx(
+        _AR_MS - _DOT_MS, abs=1e-6)
+
+
+def test_seeded_dependency_collapses_slack():
+    p = profile_hlo(_HLO_COLL_SERIALIZED, family="fixture", n_devices=8)
+    (row,) = p["collective_rows"]
+    assert row["slack_ms"] == 0.0
+    assert row["exposed_ms"] == pytest.approx(_AR_MS, abs=1e-6)
+
+
+def test_group_size_parsing():
+    # {{0,1,2,3},{4,5,6,7}} -> n=4 even on an 8-device family
+    hlo = _HLO_COLL.replace("replica_groups={{0,1,2,3,4,5,6,7}}",
+                            "replica_groups={{0,1,2,3},{4,5,6,7}}")
+    p = profile_hlo(hlo, family="fixture", n_devices=8)
+    want = ICI_LATENCY_MS + 2 * (4 - 1) / 4 * (1 << 20) / ICI_BYTES_PER_S * 1e3
+    assert p["collective_rows"][0]["cost_ms"] == pytest.approx(
+        want, abs=1e-6)
+
+
+# --- fixture C: while = condition + ONE body iteration ---------------------
+# Body crit path = the single add (3 MiB at HBM rate); gte/tuple are
+# free aliases, the condition is a free constant. The while op's cost —
+# and therefore the entry critical path AND the merged phase x class
+# composition — must equal exactly one body iteration.
+
+_HLO_WHILE = """\
+HloModule jit_w, is_scheduled=true
+
+%body.b (bp.1: (f32[262144], f32[262144])) -> (f32[262144], f32[262144]) {
+  %bp.1 = (f32[262144]{0}, f32[262144]{0}) parameter(0)
+  %g0.1 = f32[262144]{0} get-tuple-element((f32[262144]{0}, f32[262144]{0}) %bp.1), index=0
+  %g1.1 = f32[262144]{0} get-tuple-element((f32[262144]{0}, f32[262144]{0}) %bp.1), index=1
+  %w0.1 = f32[262144]{0} add(f32[262144]{0} %g0.1, f32[262144]{0} %g1.1)
+  ROOT %wt.1 = (f32[262144]{0}, f32[262144]{0}) tuple(f32[262144]{0} %w0.1, f32[262144]{0} %g1.1)
+}
+
+%cond.c (cp.1: (f32[262144], f32[262144])) -> pred[] {
+  %cp.1 = (f32[262144]{0}, f32[262144]{0}) parameter(0)
+  ROOT %lt.1 = pred[] constant(false)
+}
+
+ENTRY %main.w (p0.1: f32[262144], p1.2: f32[262144]) -> (f32[262144], f32[262144]) {
+  %p0.1 = f32[262144]{0} parameter(0)
+  %p1.2 = f32[262144]{0} parameter(1)
+  %in.3 = (f32[262144]{0}, f32[262144]{0}) tuple(f32[262144]{0} %p0.1, f32[262144]{0} %p1.2)
+  ROOT %wh.4 = (f32[262144]{0}, f32[262144]{0}) while((f32[262144]{0}, f32[262144]{0}) %in.3), condition=%cond.c, body=%body.b
+}
+"""
+
+
+def test_while_costs_one_body_iteration():
+    p = profile_hlo(_HLO_WHILE, family="fixture", n_devices=1)
+    body = _ms(3 << 20)
+    assert p["critical_path_ms"] == pytest.approx(body, abs=TOL)
+    assert p["serialized_ms"] == pytest.approx(body, abs=TOL)
+    total = sum(v for cls in p["critical_path_phase_class_ms"].values()
+                for v in cls.values())
+    assert total == pytest.approx(p["critical_path_ms"], abs=1e-5)
+
+
+def test_analyzer_exposes_per_computation_results():
+    a = analyze_hlo_schedule(_HLO_WHILE, n_devices=1)
+    assert a.analyze("body.b").crit_ms == pytest.approx(_ms(3 << 20),
+                                                        abs=TOL)
+    assert a.analyze("cond.c").crit_ms == 0.0
+
+
+# --- the lint rules on fixture-derived profiles ----------------------------
+
+
+def _coll_profile(hlo=_HLO_COLL):
+    return profile_hlo(hlo, family="train_tp", n_devices=8)
+
+
+def test_zero_slack_rule_quiet_on_clean():
+    floors = {"all-reduce": _DOT_MS / 4}
+    assert contracts.check_collective_slack(
+        "train_tp", floors, profile=_coll_profile()) == []
+
+
+def test_zero_slack_rule_fires_on_seeded_dependency():
+    floors = {"all-reduce": _DOT_MS / 4}
+    vs = contracts.check_collective_slack(
+        "train_tp", floors, profile=_coll_profile(_HLO_COLL_SERIALIZED))
+    assert [v.rule for v in vs] == ["collective-zero-slack"]
+    assert "serialize" in vs[0].message
+
+
+def test_zero_slack_rule_flags_contract_drift():
+    # a floor for a kind the module no longer contains is itself a finding
+    vs = contracts.check_collective_slack(
+        "train_tp", {"all-gather": 1e-6}, profile=_coll_profile())
+    assert [v.rule for v in vs] == ["collective-zero-slack"]
+    assert "drifted" in vs[0].message
+
+
+def test_count_consistency_quiet_on_clean():
+    assert contracts.check_collective_count_consistency(
+        "train_tp", {"psum": 1}, profile=_coll_profile()) == []
+
+
+def test_count_consistency_fires_on_dropped_psum():
+    # contract says 2 psum call sites, the compiled module carries 1 —
+    # the seeded "a collective silently disappeared" defect
+    vs = contracts.check_collective_count_consistency(
+        "train_tp", {"psum": 2}, profile=_coll_profile())
+    assert [v.rule for v in vs] == ["collective-count-consistency"]
+    assert "all-reduce" in vs[0].message
+
+
+def test_count_consistency_gspmd_is_superset():
+    p = _coll_profile()
+    # gspmd: census may exceed the declared sites but never undershoot
+    assert contracts.check_collective_count_consistency(
+        "train_tp", {}, gspmd=True, profile=p) == []
+    vs = contracts.check_collective_count_consistency(
+        "train_tp", {"psum": 2}, gspmd=True, profile=p)
+    assert [v.rule for v in vs] == ["collective-count-consistency"]
+    assert "at least" in vs[0].message
+
+
+def test_count_consistency_fires_on_parser_drift():
+    p = _coll_profile()
+    p["op_map_census"] = {"all-reduce": 3}
+    vs = contracts.check_collective_count_consistency(
+        "train_tp", {"psum": 1}, profile=p)
+    assert any("drifted apart" in v.message for v in vs)
+
+
+def test_rules_report_analysis_failure_as_finding():
+    vs = contracts.check_collective_slack("not_a_family", {"all-reduce": 1})
+    assert [v.rule for v in vs] == ["collective-zero-slack"]
+    assert "failed to analyze" in vs[0].message
+    vs = contracts.check_collective_count_consistency("not_a_family", {})
+    assert [v.rule for v in vs] == ["collective-count-consistency"]
+
+
+# --- diffing ---------------------------------------------------------------
+
+
+def test_self_diff_is_exactly_zero():
+    p = _coll_profile()
+    d = diff_schedprofiles(p, json.loads(json.dumps(p)))
+    assert d["n_flagged"] == 0
+    assert all(r["delta_ms"] == 0.0 for r in d["rows"])
+
+
+def test_diff_flags_slack_regression():
+    a = _coll_profile()
+    b = _coll_profile(_HLO_COLL_SERIALIZED)
+    # the exposure delta is small relative to the latency-dominated
+    # all-reduce cost (~0.4%), so gate it at a tight analytic threshold;
+    # the slack row itself collapses to zero and flags at any threshold
+    d = diff_schedprofiles(a, b, threshold_pct=0.1)
+    flagged = {(r["kind"], r["key"]) for r in d["rows"] if r["flagged"]}
+    assert ("slack", "all-reduce") in flagged
+    assert ("total", "predicted_exposed_ms") in flagged
+
+
+def test_diff_rejects_family_mismatch():
+    a = _coll_profile()
+    b = dict(_coll_profile(), family="train_ep_a2a")
+    with pytest.raises(ValueError, match="different families"):
+        diff_schedprofiles(a, b)
+
+
+# --- CPU end-to-end on real registered families ----------------------------
+
+
+@pytest.fixture(scope="module")
+def train_tp_profile():
+    return schedkit.profile_family_cached("train_tp")
+
+
+@pytest.fixture(scope="module")
+def train_ep_profile():
+    return schedkit.profile_family_cached("train_ep_a2a")
+
+
+@pytest.mark.parametrize("fam", ["train_tp_profile", "train_ep_profile"])
+def test_family_composition_sums_and_census_crosscheck(fam, request):
+    p = request.getfixturevalue(fam)
+    assert p["schema"] == "schedprofile/v1"
+    total = sum(v for cls in p["critical_path_phase_class_ms"].values()
+                for v in cls.values())
+    assert total == pytest.approx(p["critical_path_ms"], abs=1e-4)
+    assert 0.0 < p["schedule_efficiency"] <= 1.0
+    # the anti-drift tripwire: schedkit's DAG census and tracekit's
+    # instruction-map census of the SAME module must agree
+    assert p["collectives"] == p["op_map_census"]
+    assert p["collectives"], "sharded family must carry collectives"
+
+
+def test_train_tp_slack_pools_hold_declared_floors(train_tp_profile):
+    from cs336_systems_tpu.parallel import tp
+
+    floors = tp.lint_contract()["collective_slack_floor_ms"]
+    pools = {}
+    for r in train_tp_profile["collective_rows"]:
+        pools[r["kind"]] = pools.get(r["kind"], 0.0) + r["slack_ms"]
+    for kind, floor in floors.items():
+        assert pools.get(kind, 0.0) >= floor, (kind, pools)
+
+
+def test_train_ep_slack_pools_hold_declared_floors(train_ep_profile):
+    from cs336_systems_tpu.analysis import registry
+    from cs336_systems_tpu.parallel import ep
+
+    floors = ep.lint_contract(registry._moe_cfg())[
+        "collective_slack_floor_ms"]
+    pools = {}
+    for r in train_ep_profile["collective_rows"]:
+        pools[r["kind"]] = pools.get(r["kind"], 0.0) + r["slack_ms"]
+    for kind, floor in floors.items():
+        assert pools.get(kind, 0.0) >= floor, (kind, pools)
+
+
+def test_unknown_family_raises():
+    with pytest.raises(KeyError, match="unknown step family"):
+        schedkit.profile_family("not_a_family")
+
+
+@pytest.mark.slow
+def test_every_registered_family_profiles():
+    """schedprofile/v1 builds for ALL registered targets (the 17 step
+    families + the bench shapes) and every composition sums to its
+    critical-path total."""
+    for fam in schedkit.family_names():
+        p = schedkit.profile_family(fam)
+        assert p["schema"] == "schedprofile/v1", fam
+        total = sum(v for cls in p["critical_path_phase_class_ms"].values()
+                    for v in cls.values())
+        assert total == pytest.approx(p["critical_path_ms"],
+                                      abs=1e-4), fam
+
+
+# --- sched_cli -------------------------------------------------------------
+
+
+def test_sched_cli_list_matches_memkit(capsys):
+    from cs336_systems_tpu.analysis import memkit, sched_cli
+
+    assert sched_cli.main(["--list"]) == 0
+    out = capsys.readouterr().out.split()
+    assert out == memkit.family_names()
+
+
+def test_sched_cli_diff_roundtrip(tmp_path, capsys):
+    from cs336_systems_tpu.analysis import sched_cli
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    schedkit.write_profile(_coll_profile(), str(a))
+    schedkit.write_profile(_coll_profile(_HLO_COLL_SERIALIZED), str(b))
+    assert sched_cli.main(["--diff", str(a), str(a)]) == 0
+    capsys.readouterr()
+    assert sched_cli.main(["--diff", str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert "FLAGGED" in out
+
+
+def test_sched_cli_step_writes_artifact(tmp_path, capsys):
+    from cs336_systems_tpu.analysis import sched_cli
+
+    out = tmp_path / "p.json"
+    assert sched_cli.main(["--step", "serve_dp", "--out", str(out)]) == 0
+    p = json.loads(out.read_text())
+    assert p["schema"] == "schedprofile/v1"
+    assert p["family"] == "serve_dp" and p["n_devices"] == 8
+    text = capsys.readouterr().out
+    assert "critical path" in text and "efficiency" in text
+
+
+def test_sched_cli_unknown_family_exits_1(capsys):
+    from cs336_systems_tpu.analysis import sched_cli
+
+    assert sched_cli.main(["--step", "nope"]) == 1
+    assert "unknown step family" in capsys.readouterr().err
+
+
+def test_format_profile_renders(train_tp_profile):
+    text = schedkit.format_profile(train_tp_profile)
+    assert "critical path" in text
+    assert "slack table" in text
+
+
+# --- cross-validation against tracekit (the measured half) -----------------
+
+
+def test_predicted_exposure_ordering_matches_tracekit(
+        train_tp_profile, train_ep_profile):
+    """The static and measured halves of the overlap story must agree on
+    ORDERING for the pinned families: schedkit predicts train_tp's
+    collectives are harder to hide than train_ep_a2a's (the chunked-CE
+    psums sit in scan bodies with little independent compute; the a2a
+    dispatch runs against the expert FFN work), and tracekit's measured
+    hidden/exposed split must rank them the same way. Exposed FRACTIONS
+    (exposed / total collective time) are compared, not walls — CPU-mesh
+    wall times jitter run to run; the fractions are steadier but still
+    carry ±0.05 of single-host scheduling noise (measured spread: tp
+    0.48–0.57, ep 0.48–0.53), so the measured half asserts NO CONFIDENT
+    CONTRADICTION (margin 0.10) rather than strict ordering — a real
+    overlap regression (a fully-hidden tp or fully-exposed ep) moves the
+    fraction by far more than the margin."""
+    from cs336_systems_tpu.analysis import tracekit
+
+    pred = {}
+    for fam, prof in (("train_tp", train_tp_profile),
+                      ("train_ep_a2a", train_ep_profile)):
+        assert prof["predicted_exposed_ms"] <= prof["collective_cost_ms"]
+        pred[fam] = prof["predicted_exposed_ms"] / prof["collective_cost_ms"]
+    assert pred["train_tp"] > pred["train_ep_a2a"]
+
+    meas = {}
+    for fam in ("train_tp", "train_ep_a2a"):
+        t = tracekit.profile_step(fam, iters=1)
+        total = sum(v for c, v in t["class_ms"].items()
+                    if c.startswith("collective-"))
+        assert t["collective_hidden_ms"] + t["collective_exposed_ms"] == \
+            pytest.approx(total, abs=1e-2)
+        meas[fam] = t["collective_exposed_ms"] / total
+    assert meas["train_tp"] > meas["train_ep_a2a"] - 0.10, (meas, pred)
