@@ -160,7 +160,17 @@ def fold(engine, *, family: str | None = None,
     """Fold a (drained or mid-flight) engine's flight log into the
     canonical servetrace/v1 dict. ``device_profile``: a tracekit
     StepProfile of the same family — its total_device_ms_per_step joins
-    in as the host-vs-device split; None leaves the field null."""
+    in as the host-vs-device split; None leaves the field null.
+
+    A ``FleetRouter`` (anything with a ``replicas`` attribute, ISSUE 14)
+    folds through ``fold_fleet``: same schema plus the additive
+    ``fleet`` section — old single-engine artifacts keep folding (and
+    ``--diff``-ing against committed baselines) byte-for-byte
+    unchanged."""
+    if hasattr(engine, "replicas"):
+        return fold_fleet(engine, family=family,
+                          device_profile=device_profile,
+                          windows=windows, meta=meta)
     import jax
 
     fr = engine.flight
@@ -240,6 +250,142 @@ def fold(engine, *, family: str | None = None,
             "ok": emitted == terminal + live,
         },
         "nonfinite_spans": fr.nonfinite_spans,
+    }
+
+
+def fold_fleet(router, *, family: str | None = None,
+               device_profile: dict | None = None, windows: int = 8,
+               meta: dict | None = None) -> dict:
+    """Fold a ``FleetRouter``'s replicas into one servetrace/v1 dict.
+
+    Each replica's log is decomposed SEPARATELY (step records are keyed
+    by the replica-local step counter ``i`` — merging raw logs would
+    collide them) and the per-request components merged: a request's
+    submit→running→finish chain completes on exactly one replica's log
+    (the replica it finished on; the drained replica recorded a
+    ``cancel``, not a ``finish``), and a failed-over request's
+    queue_wait naturally absorbs the failover replay delay. Additive
+    fields on top of the single-engine schema: ``requests.failovers``
+    and the ``fleet`` section (per-replica engine-steps/s, health
+    states, quarantine count) — the diff gate reads neither, so fleet
+    artifacts diff against each other under the same dual noise gate."""
+    import jax
+
+    engines = [rep.engine for rep in router.replicas]
+    per_req: dict[int, dict] = {}
+    skipped = 0
+    for eng in engines:
+        dec, sk = decompose(eng)
+        skipped += sk
+        for rid, comp in dec.items():
+            if rid in router.results:  # the finishing replica's chain
+                per_req.setdefault(rid, comp)
+    comps: dict[str, dict] = {}
+    for c in COMPONENTS + ("e2e",):
+        vals = [r[c] for r in per_req.values()]
+        comps[c] = _pct(vals) if vals else None
+    ttfts = [r["ttft"] for r in per_req.values() if r["ttft"] is not None]
+    comps["ttft"] = _pct(ttfts) if ttfts else None
+
+    all_steps: list[dict] = []
+    per_replica = []
+    for rep in router.replicas:
+        fr = rep.engine.flight
+        finite = [s for s in fr.steps
+                  if math.isfinite(s["t0"]) and math.isfinite(s["t1"])]
+        all_steps.extend(finite)
+        rspan = (finite[-1]["t1"] - finite[0]["t0"]) if finite else 0.0
+        done = sum(1 for rid in router.results
+                   if rid in rep.engine.results)
+        hit, tot = rep.engine.prefix_hit_tokens, rep.engine.prefix_prompt_tokens
+        per_replica.append({
+            "replica": rep.idx,
+            "state": rep.state,
+            "steps": len(finite),
+            "engine_steps_per_s": (round(len(finite) / rspan, 2)
+                                   if rspan > 0 else None),
+            "completed": done,
+            "prefix_hit_rate": (round(hit / tot, 4) if tot else None),
+        })
+    all_steps.sort(key=lambda s: s["t0"])
+    n_steps = len(all_steps)
+    span = (max(s["t1"] for s in all_steps)
+            - min(s["t0"] for s in all_steps)) if n_steps else 0.0
+    phase_tot = {p: sum(s["phases"][p] for s in all_steps)
+                 for p in (all_steps[0]["phases"] if n_steps else {})}
+    total = sum(phase_tot.values())
+    host = sum(phase_tot.get(p, 0.0) for p in HOST_PHASES)
+
+    emitted = terminal = 0
+    poisoned = cancelled = 0
+    for eng in engines:
+        fr = eng.flight
+        emitted += sum(len(s["emits"]) for s in fr.steps)
+        terminal += sum(e.get("tokens", 0) for e in fr.events
+                        if e["kind"] in ("finish", "cancel", "poison"))
+        kinds = [e["kind"] for e in fr.events]
+        poisoned += kinds.count("poison")
+        cancelled += kinds.count("cancel")
+    live = sum(len(r.tokens) for r in router.running.values())
+
+    return {
+        "schema": SCHEMA,
+        "family": family,
+        "backend": jax.default_backend(),
+        "slots": router.slots,
+        "dp": router.dp,
+        "meta": meta or {},
+        "requests": {
+            "submitted": len(router._requests),
+            "completed": len(router.results),
+            "shed": len(router.failed),
+            "cancelled": cancelled,
+            "poisoned": poisoned,
+            "decomposed": len(per_req),
+            "nonfinite_skipped": skipped,
+            "failovers": router.failovers,
+        },
+        "components_ms": comps,
+        "steps": {
+            "n": n_steps,
+            "span_s": round(span, 6),
+            "engine_steps_per_s": (round(n_steps / span, 2)
+                                   if span > 0 else None),
+            "n_saturated": sum(
+                1 for s in all_steps
+                if s.get("counters", {}).get("running")
+                == router.slots // max(len(engines), 1)),
+            "saturated_steps_per_s": None,
+            "total_ms_per_step": (round(total / n_steps * 1e3, 4)
+                                  if n_steps else 0.0),
+            "phase_ms_per_step": {
+                p: round(v / n_steps * 1e3, 4) if n_steps else 0.0
+                for p, v in phase_tot.items()},
+            "host_ms_per_step": (round(host / n_steps * 1e3, 4)
+                                 if n_steps else 0.0),
+            "host_overhead_pct": (round(host / total * 100.0, 2)
+                                  if total > 0 else 0.0),
+            "device_ms_per_step": (
+                device_profile.get("total_device_ms_per_step")
+                if device_profile else None),
+        },
+        "counters": _windows(all_steps, windows),
+        "conservation": {
+            "emitted_tokens": emitted,
+            "terminal_tokens": terminal,
+            "live_tokens": live,
+            "ok": emitted == terminal + live,
+        },
+        "nonfinite_spans": sum(e.flight.nonfinite_spans for e in engines),
+        "fleet": {
+            "replicas": len(engines),
+            "router_policy": router.policy,
+            "states": router.states(),
+            "failovers": router.failovers,
+            "quarantines": router.quarantines,
+            "faults_absorbed": len(router.faults),
+            "per_replica": per_replica,
+        },
     }
 
 
@@ -357,8 +503,20 @@ def format_report(p: dict) -> str:
         f"completed  {r['shed']} shed  {r['cancelled']} cancelled  "
         f"{r['poisoned']} poisoned  ({r['decomposed']} decomposed, "
         f"{r['nonfinite_skipped']} non-finite skipped)",
-        "  latency decomposition (ms):",
     ]
+    fl = p.get("fleet")
+    if fl:
+        per = "  ".join(
+            f"r{q['replica']}[{q['state']}] {q['steps']} steps"
+            + (f" @{q['engine_steps_per_s']}/s"
+               if q["engine_steps_per_s"] else "")
+            for q in fl["per_replica"])
+        lines.append(
+            f"  fleet: {fl['replicas']} replicas ({fl['router_policy']})  "
+            f"{fl['failovers']} failovers  {fl['quarantines']} "
+            f"quarantines  {fl['faults_absorbed']} faults absorbed")
+        lines.append(f"    {per}")
+    lines.append("  latency decomposition (ms):")
     for comp in COMPONENTS + ("e2e", "ttft"):
         c = (p.get("components_ms") or {}).get(comp)
         if c is None:

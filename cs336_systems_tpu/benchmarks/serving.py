@@ -55,6 +55,19 @@ dumps each cell's full servetrace/v1 artifact for
 ``math.inf`` stamp on cancel/evict paths) are dropped before every
 percentile.
 
+``--replicas N`` (ISSUE 14) runs each cell against a ``FleetRouter``
+over N engine replicas instead of one engine — ``--router
+affinity|random|least-loaded`` emits one TWIN CELL per policy on
+identically-seeded arrivals (same requests, same poisson gaps), so the
+fleet goodput under ``--slo-ms``, per-replica ``prefix_hit_rate`` and
+reject rate isolate the ROUTING decision. ``--kill-replica-at K``
+quarantines replica 0 at router step K mid-trace (the
+replica-kill-mid-trace recovery smoke): in-flight requests fail over
+and replay bit-exact, and goodput must degrade proportionally — the
+fleet row gains ``failovers`` / ``quarantines`` / ``replica_states`` /
+``per_replica_hit_rate``. ``--slots`` is PER REPLICA, so fleet capacity
+is N x slots.
+
 Every cell flushes via ``emit_row`` the moment it completes (``--out``
 makes the cells durable JSONL), and every trace ends with the page-pool
 conservation check — a leaked page fails the cell, which is the CI
@@ -98,10 +111,12 @@ from cs336_systems_tpu.models.transformer import (
 )
 from cs336_systems_tpu.serving import (
     DeadlinePolicy,
+    FleetRouter,
     Request,
     ServingEngine,
     ServingError,
 )
+from cs336_systems_tpu.serving.router import POLICIES
 from cs336_systems_tpu.utils.timing import emit_row, print_table, results_table
 
 
@@ -216,7 +231,7 @@ def run_cell(engine: ServingEngine, requests: list[Request],
 
     if servetrace_path:
         write_profile(art, servetrace_path)
-    return {
+    row = {
         "completed": len(results),
         "shed": len(shed),
         "reject_rate": round(len(shed) / max(len(requests), 1), 4),
@@ -245,6 +260,17 @@ def run_cell(engine: ServingEngine, requests: list[Request],
         "decode_p99_ms": _p99("decode"),
         "host_overhead_pct": art["steps"]["host_overhead_pct"],
     }
+    if hasattr(engine, "replicas"):
+        # fleet columns (ISSUE 14): routing/health outcome of the cell
+        row.update({
+            "failovers": engine.failovers,
+            "quarantines": engine.quarantines,
+            "replica_states": ",".join(engine.states()),
+            "per_replica_hit_rate": ";".join(
+                f"{e.prefix_hit_tokens / max(e.prefix_prompt_tokens, 1):.4f}"
+                for e in engine.engines),
+        })
+    return row
 
 
 def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
@@ -253,7 +279,10 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
           slo_ms: float, out_path: str | None, shared_prefix: int = 0,
           prefix_cache: bool = True,
           deadline_ms: float = 0.0,
-          servetrace_path: str | None = None) -> list[dict]:
+          servetrace_path: str | None = None,
+          replicas: int = 0,
+          router_policies: list[str] | None = None,
+          kill_at: int = 0) -> list[dict]:
     params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
     mesh = dp_axis = None
     if dp:
@@ -261,19 +290,45 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
 
         mesh, dp_axis = make_mesh({"dp": dp}), "dp"
 
-    def make_engine(policy=None):
-        t0 = time.monotonic()
-        # fresh engine per run: the trace starts at clock 0 with a cold
-        # pool, so cells (and the deadline A/B twins) are independent
-        # and replayable
+    def make_one(policy=None, clock=None):
         return ServingEngine(
             params, cfg, key=jax.random.PRNGKey(0), slots=slots,
             n_pages=n_pages, max_blocks=max_blocks,
             page_block=page_block, temperature=0.9, top_k=8,
             mesh=mesh, dp_axis=dp_axis, prefix_cache=prefix_cache,
-            policy=policy, clock=lambda: time.monotonic() - t0)
+            policy=policy, clock=clock)
+
+    def make_engine(policy_factory=None):
+        t0 = time.monotonic()
+        # fresh engine per run: the trace starts at clock 0 with a cold
+        # pool, so cells (and the deadline A/B twins) are independent
+        # and replayable
+        return make_one(policy=policy_factory() if policy_factory else None,
+                        clock=lambda: time.monotonic() - t0)
+
+    def make_fleet(router_policy, policy_factory=None):
+        # N replicas sharing one trace clock and ONE base key — the
+        # failover bit-exactness precondition the router checks
+        t0 = time.monotonic()
+        engines = [
+            make_one(policy=policy_factory() if policy_factory else None,
+                     clock=lambda: time.monotonic() - t0)
+            for _ in range(replicas)]
+        router = FleetRouter(engines, policy=router_policy, seed=seed)
+        if kill_at > 0:
+            # the replica-kill-mid-trace seam: quarantine replica 0 at
+            # router step K (idempotent — kill of a quarantined replica
+            # is a no-op), forcing mid-stream failover under load
+            router.on_step = (
+                lambda r: r.kill(0, why=f"benchmark kill at step "
+                                        f"{kill_at}")
+                if r.rounds >= kill_at else None)
+        return router
 
     rows = []
+    variants = ([("engine", None)] if not replicas else
+                [(f"fleet{replicas}_{pol}", pol)
+                 for pol in (router_policies or ["affinity"])])
     for load in loads:
         for profile in profiles:
             def make_requests():
@@ -281,37 +336,50 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
                                       new_tokens, load, cfg.vocab_size,
                                       seed, shared_prefix, deadline_ms)
 
-            row = {"name": f"engine_poisson_{profile}_load{load:g}",
-                   "load_rps": load, "profile": profile,
-                   "requests": n_requests, "slots": slots,
-                   "n_pages": n_pages, "slo_ms": slo_ms,
-                   "shared_prefix": shared_prefix, "seed": seed}
-            st_path = None
-            if servetrace_path:
-                # one artifact per cell: insert the cell name so a
-                # multi-cell sweep doesn't overwrite itself
-                stem, ext = os.path.splitext(servetrace_path)
-                st_path = f"{stem}.{row['name']}{ext or '.json'}"
-            row.update(run_cell(make_engine(), make_requests(), slo_ms,
-                                servetrace_path=st_path))
-            if deadline_ms > 0:
-                # the admission-control A/B twin: identical seeded
-                # arrivals, DeadlinePolicy instead of strict FIFO —
-                # queue-expired requests shed with the retriable typed
-                # DeadlineExceeded instead of being served late
-                fifo_goodput = row.pop("deadline_goodput_tok_s")
-                twin = run_cell(make_engine(policy=DeadlinePolicy()),
-                                make_requests(), slo_ms)
-                row.update({
-                    "deadline_ms": deadline_ms,
-                    "fifo_goodput_tok_s": fifo_goodput,
-                    "shed_goodput_tok_s": twin["deadline_goodput_tok_s"],
-                    "reject_rate": twin["reject_rate"],
-                    "shed": twin["shed"],
-                    "p99_shed_ms": twin["p99_ms"],
-                })
-            emit_row(row, out_path)
-            rows.append(row)
+            for stem_name, rpol in variants:
+                row = {"name": f"{stem_name}_poisson_{profile}_"
+                               f"load{load:g}",
+                       "load_rps": load, "profile": profile,
+                       "requests": n_requests, "slots": slots,
+                       "n_pages": n_pages, "slo_ms": slo_ms,
+                       "shared_prefix": shared_prefix, "seed": seed}
+                if rpol is not None:
+                    row.update({"replicas": replicas,
+                                "router_policy": rpol,
+                                "kill_replica_at": kill_at})
+                st_path = None
+                if servetrace_path:
+                    # one artifact per cell: insert the cell name so a
+                    # multi-cell sweep doesn't overwrite itself
+                    stem, ext = os.path.splitext(servetrace_path)
+                    st_path = f"{stem}.{row['name']}{ext or '.json'}"
+
+                def build():
+                    return (make_engine() if rpol is None
+                            else make_fleet(rpol))
+
+                row.update(run_cell(build(), make_requests(), slo_ms,
+                                    servetrace_path=st_path))
+                if deadline_ms > 0:
+                    # the admission-control A/B twin: identical seeded
+                    # arrivals, DeadlinePolicy instead of strict FIFO —
+                    # queue-expired requests shed with the retriable
+                    # typed DeadlineExceeded instead of being served late
+                    fifo_goodput = row.pop("deadline_goodput_tok_s")
+                    twin_eng = (make_engine(DeadlinePolicy) if rpol is None
+                                else make_fleet(rpol, DeadlinePolicy))
+                    twin = run_cell(twin_eng, make_requests(), slo_ms)
+                    row.update({
+                        "deadline_ms": deadline_ms,
+                        "fifo_goodput_tok_s": fifo_goodput,
+                        "shed_goodput_tok_s":
+                            twin["deadline_goodput_tok_s"],
+                        "reject_rate": twin["reject_rate"],
+                        "shed": twin["shed"],
+                        "p99_shed_ms": twin["p99_ms"],
+                    })
+                emit_row(row, out_path)
+                rows.append(row)
     return rows
 
 
@@ -360,6 +428,19 @@ def main() -> None:
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the engine's prefix cache (the unshared "
                         "A/B baseline)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="run each cell against a FleetRouter over N "
+                        "engine replicas instead of one engine "
+                        "(--slots is PER REPLICA; 0 = single engine)")
+    p.add_argument("--router", nargs="*", default=["affinity"],
+                   choices=list(POLICIES),
+                   help="with --replicas: one twin cell per routing "
+                        "policy on identically-seeded arrivals")
+    p.add_argument("--kill-replica-at", type=int, default=0,
+                   metavar="STEP",
+                   help="with --replicas: quarantine replica 0 at this "
+                        "router step mid-trace — in-flight requests "
+                        "fail over and replay bit-exact (0 = off)")
     p.add_argument("--dp", type=int, default=0,
                    help="shard slots over a dp mesh of this size (0 = "
                         "single device)")
@@ -420,7 +501,9 @@ def main() -> None:
                  args.out, shared_prefix=args.shared_prefix,
                  prefix_cache=not args.no_prefix_cache,
                  deadline_ms=args.deadline_ms,
-                 servetrace_path=args.servetrace)
+                 servetrace_path=args.servetrace,
+                 replicas=args.replicas, router_policies=args.router,
+                 kill_at=args.kill_replica_at)
     print_table(results_table(rows, latex_path=args.latex))
 
 

@@ -34,6 +34,17 @@ step program byte-identical recorder on/off — that
 analysis/servetrace.py folds into the CI-diffable servetrace/v1
 artifact (per-request latency decomposition, engine-steps/s with the
 host-phase breakdown, counter windows).
+
+ISSUE 14 adds the fleet layer (router.py): a ``FleetRouter`` over N
+independent engine replicas — prefix-affinity dispatch keyed by the
+PrefixCache chain hash (same-prefix sessions land on the replica that
+already holds the KV), a healthy → degraded → quarantined health machine
+driven by the typed error surface, and mid-stream failover whose
+replayed streams are bit-identical (per-request key chain) behind an
+at-most-once emit cursor. All host-side control plane — the jit step
+program is untouched. The proof is fleetsan (fleet_chaos.py — ``python
+-m cs336_systems_tpu.serving.fleet_chaos``), the fleet-level chaos
+harness in the gradsan/servesan shape.
 """
 
 from cs336_systems_tpu.serving.engine import ServingEngine, make_engine_step
@@ -42,12 +53,15 @@ from cs336_systems_tpu.serving.errors import (
     AdmissionImpossible,
     CorruptBlockTable,
     DeadlineExceeded,
+    FleetInvariantViolation,
     InvariantViolation,
     PoolExhausted,
     RefcountViolation,
+    ReplicaUnavailable,
     ServingError,
     SlotPoisoned,
 )
+from cs336_systems_tpu.serving.router import FleetRouter
 from cs336_systems_tpu.serving.pool import PagePool
 from cs336_systems_tpu.serving.prefix_cache import (
     PrefixCache,
@@ -68,12 +82,15 @@ __all__ = [
     "DeadlineExceeded",
     "DeadlinePolicy",
     "FifoPolicy",
+    "FleetInvariantViolation",
+    "FleetRouter",
     "FlightRecorder",
     "InvariantViolation",
     "PagePool",
     "PoolExhausted",
     "PrefixCache",
     "RefcountViolation",
+    "ReplicaUnavailable",
     "Request",
     "Scheduler",
     "ServingEngine",

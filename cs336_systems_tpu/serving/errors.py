@@ -114,3 +114,37 @@ class InvariantViolation(ServingError, AssertionError):
     — drain and rebuild. ``shard``/``detail`` say where and what."""
 
     retriable = False
+
+
+class ReplicaUnavailable(ServingError):
+    """A fleet replica cannot take (or keep) a request right now: it
+    crashed mid-step, tripped the dispatch watchdog, was quarantined by
+    the router's health machine, or an affinity entry pointed at a
+    replica that is no longer serving. The REQUEST is fine — the router
+    re-dispatches it to a survivor (replaying from the prompt; the
+    per-request key chain makes the retried stream bit-identical), and a
+    caller seeing this error may safely resubmit once any replica is
+    healthy. ``replica``: the unavailable replica's index in the fleet,
+    ``None`` when the whole fleet is down (the shed-storm case)."""
+
+    retriable = True
+
+    def __init__(self, detail: str = "", shard: int | None = None,
+                 replica: int | None = None):
+        self.replica = replica
+        if replica is not None:
+            detail = f"replica {replica}: {detail}"
+        super().__init__(detail, shard=shard)
+
+
+class FleetInvariantViolation(InvariantViolation):
+    """A FLEET-level invariant broke in the router's control plane: a
+    rid live on two replicas at once (duplicate dispatch — the
+    at-most-once emit contract is about to tear), an affinity entry
+    naming a replica index outside the fleet, or a retried stream whose
+    replayed tokens diverge from the already-delivered prefix (a torn
+    stream). Router state is corrupt — not retriable; subclasses
+    ``InvariantViolation`` so existing invariant handlers and
+    ``pytest.raises(AssertionError)`` sites keep working."""
+
+    retriable = False

@@ -1,0 +1,598 @@
+"""fleetsan — the fleet-router chaos harness (ISSUE 14).
+
+    python -m cs336_systems_tpu.serving.fleet_chaos --list
+    python -m cs336_systems_tpu.serving.fleet_chaos              # all + clean
+    python -m cs336_systems_tpu.serving.fleet_chaos --fault replica-crash --json
+    python -m cs336_systems_tpu.serving.fleet_chaos --mesh dp2 --seed 3
+
+The gradsan/servesan pattern one level up: servesan proves a SINGLE
+engine's invariant sweep catches allocator/table corruption; fleetsan
+proves the ROUTER's failure semantics — health machine, watchdog,
+failover, emit cursor, routing-table sweep — against seeded fleet-level
+faults. Each fault perturbs a REAL 3-replica fleet mid-trace: 10
+requests in two shared-prefix sessions (affinity pins each session to
+one replica, so the refcounted shared-page regime is live on two
+replicas at once) join, stream and evict over a virtual clock; after
+``PRE_STEPS`` clean steps the named seam is corrupted and the harness
+keeps stepping, running ``FleetRouter.self_check`` after every step.
+
+The verdict is STRICTER than servesan's: the expected typed error must
+surface (raised for router-state corruption, ABSORBED into
+``router.faults``/``router.failed`` for replica failures — absorption IS
+the contract: a replica dying must not throw at the client), every
+surviving or failed-over stream must be BIT-EXACT to the single-replica
+row-keyed oracle (the per-request key chain makes a replayed stream a
+pure function of (params, base key, row, prompt)), no request may be
+lost, duplicated or torn, and each fault's structural postcondition
+must hold (the crashed replica quarantined, the shed storm ending with
+every request retriably failed — degradation, never a hang). The clean
+run must drain with zero findings, zero failovers and a fully-free pool
+on every replica — the false-positive gate.
+
+Everything is seeded and host-side: the jit step programs are never
+touched (step-program invariance is pinned by the serve_engine lint
+families), so verdicts are identical on single-device and dp2-per-
+replica meshes.
+
+Exit status: 0 every requested fault detected with the expected typed
+error and bit-exact survivors (and the clean run clean), 1 a fault was
+MISSED / misclassified / tore a stream, 2 the trace failed to build.
+Same gate semantics as gradsan — scripts/run_tests_and_package.sh wires
+it into CI as-is.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Force the hermetic CPU backend BEFORE jax initializes (the site TPU
+# plugin must not grab the tunneled chip for a host-side control-plane
+# check) — same pattern as chaos.py; CS336_TPU_CHAOS=1 opts out.
+if not os.environ.get("CS336_TPU_CHAOS"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import argparse
+import json
+import re
+import sys
+import traceback
+
+import numpy as np
+
+from cs336_systems_tpu.serving.errors import (
+    FleetInvariantViolation,
+    ReplicaUnavailable,
+    ServingError,
+    SlotPoisoned,
+)
+
+N_REPLICAS = 3   # the standard fleet
+SLOTS = 4        # per replica (divisible by the dp2 mesh)
+N_PAGES = 16     # per replica per shard — ample for 4 slots x 3 blocks
+MAX_BLOCKS = 3   # 12-token prompt + up to 7 new tokens at blk=8
+PRE_STEPS = 3    # clean fleet steps before the injection
+MAX_STEPS = 96   # post-injection bound (failover replays from the prompt)
+LATE_RID = 100   # the stale-affinity fault's late same-session request
+
+
+class ChaosBuildError(RuntimeError):
+    """The fleet trace could not be built/driven far enough to inject —
+    exit 2 territory, distinct from a missed detection."""
+
+
+# -- the standard trace -------------------------------------------------
+
+
+def _blk() -> int:
+    from cs336_systems_tpu.analysis.registry import serve_chaos_geometry
+
+    return serve_chaos_geometry()[3]
+
+
+def _params(seed: int):
+    import jax
+
+    from cs336_systems_tpu.analysis.registry import _tiny_cfg
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+
+    cfg = _tiny_cfg()
+    return init_transformer_lm(jax.random.PRNGKey(seed), cfg), cfg
+
+
+def _build_fleet(mesh_name: str = "none", seed: int = 0):
+    """The standard chaos fleet: 3 replicas, SAME base key (the failover
+    bit-exactness precondition), prefix caches on, affinity policy,
+    virtual clock (the harness passes explicit ``now``)."""
+    import jax
+
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.serving.engine import ServingEngine
+    from cs336_systems_tpu.serving.router import FleetRouter
+
+    params, cfg = _params(seed)
+    mesh = dp = None
+    if mesh_name == "dp2":
+        mesh, dp = make_mesh({"dp": 2}), "dp"
+    elif mesh_name != "none":
+        raise ChaosBuildError(f"unknown mesh {mesh_name!r} (none | dp2)")
+    engines = [
+        ServingEngine(params, cfg, key=jax.random.PRNGKey(seed + 1),
+                      slots=SLOTS, n_pages=N_PAGES, max_blocks=MAX_BLOCKS,
+                      page_block=_blk(), mesh=mesh, dp_axis=dp)
+        for _ in range(N_REPLICAS)]
+    return FleetRouter(engines, policy="affinity", seed=seed)
+
+
+def _prefixes(seed: int):
+    """Two full-block session prefixes — affinity pins each session to
+    one replica, so a fault on the busiest replica always has a warm
+    survivor session to interleave with."""
+    rng = np.random.default_rng(seed)
+    blk, vocab = _blk(), 64  # registry _tiny_cfg vocab
+    return rng.integers(0, vocab, size=blk), rng.integers(0, vocab, size=blk)
+
+
+def _build_requests(seed: int):
+    """10 requests: session A (even rids) and session B (odd rids), each
+    a shared full prefix block + a distinct 4-token tail, ``max_new =
+    4 + (i % 4)`` so evictions are staggered — early finishers free
+    slots mid-trace while the longest-lived requests still stream."""
+    from cs336_systems_tpu.serving.scheduler import Request
+
+    pref_a, pref_b = _prefixes(seed)
+    rng = np.random.default_rng(seed + 1)
+    reqs = []
+    for i in range(10):
+        tail = rng.integers(0, 64, size=4)
+        prefix = pref_a if i % 2 == 0 else pref_b
+        prompt = np.concatenate([prefix, tail]).astype(np.int32)
+        reqs.append(Request(i, prompt, max_new_tokens=4 + (i % 4),
+                            arrival=0.0))
+    return reqs
+
+
+def _late_request(seed: int):
+    """The stale-affinity fault's late arrival: session A's prefix with
+    a fresh tail, submitted AFTER the pinned replica was quarantined."""
+    from cs336_systems_tpu.serving.scheduler import Request
+
+    pref_a, _ = _prefixes(seed)
+    tail = np.random.default_rng(seed + 2).integers(0, 64, size=4)
+    prompt = np.concatenate([pref_a, tail]).astype(np.int32)
+    return Request(LATE_RID, prompt, max_new_tokens=4, arrival=0.0)
+
+
+_ORACLE_CACHE: dict = {}
+
+
+def _oracle_results(seed: int, include_late: bool) -> dict:
+    """The single-replica row-keyed oracle: ONE clean engine with ample
+    capacity over clones of the same requests. A stream is a pure
+    function of (params, base key, row, prompt), so every fleet stream —
+    original, failed-over, or late — must match this bitwise."""
+    key = (seed, include_late)
+    if key not in _ORACLE_CACHE:
+        import jax
+
+        from cs336_systems_tpu.serving.engine import ServingEngine
+
+        params, cfg = _params(seed)
+        eng = ServingEngine(params, cfg, key=jax.random.PRNGKey(seed + 1),
+                            slots=8, n_pages=64, max_blocks=MAX_BLOCKS,
+                            page_block=_blk())
+        reqs = _build_requests(seed)
+        if include_late:
+            reqs.append(_late_request(seed))
+        for r in reqs:
+            eng.submit(r)
+        tick = iter(np.arange(0.0, 1e4, 1.0))
+        eng.run(time_fn=lambda: float(next(tick)))
+        if set(eng.results) != {r.rid for r in reqs}:
+            raise ChaosBuildError("oracle did not complete every request")
+        _ORACLE_CACHE[key] = {
+            rid: np.asarray(arr) for rid, arr in eng.results.items()}
+    return _ORACLE_CACHE[key]
+
+
+def _busiest(router):
+    """The non-quarantined replica with the most live work — the fault
+    victim (ties: lowest index, deterministic)."""
+    cand = [rep for rep in router.replicas if rep.state != "quarantined"
+            and (rep.engine.running or len(rep.engine.scheduler))]
+    if not cand:
+        raise ChaosBuildError("no busy replica to injure")
+    return max(cand, key=lambda rep: (
+        len(rep.engine.running) + len(rep.engine.scheduler), -rep.idx))
+
+
+# -- the fault injectors (each takes (router, seed)) --------------------
+
+
+def _inject_replica_crash(router, seed):
+    """The busiest replica's step raises a non-ServingError mid-stream —
+    a segfault/device-loss stand-in. The router must quarantine it,
+    drain, and fail its in-flight streams over to survivors."""
+    rep = _busiest(router)
+
+    def _boom(now=None):
+        raise RuntimeError("injected segfault: replica device lost")
+
+    rep.engine.step = _boom
+
+
+def _inject_replica_hang(router, seed):
+    """The busiest replica keeps 'running' slots but produces zero
+    events — a wedged dispatch. Silence past ``watchdog_steps`` must
+    trip the dispatch watchdog and quarantine it."""
+    rep = _busiest(router)
+    if not rep.engine.running:
+        raise ChaosBuildError("hang victim has no running slots")
+    rep.engine.step = lambda now=None: []
+
+
+def _inject_poisoned_replica(router, seed):
+    """Every step, the busiest replica's carried sampling state goes
+    non-finite (a sick host/HBM stand-in). The engine's own containment
+    evicts with retriable SlotPoisoned each time; REPEATED poison must
+    accumulate strikes until the health machine quarantines the replica,
+    and every contained request must complete elsewhere bit-exact."""
+    rep = _busiest(router)
+    eng, orig = rep.engine, rep.engine.step
+
+    def _sick(now=None):
+        ev = orig(now)
+        for slot in list(eng.running):
+            eng.logits[slot, : min(8, eng.logits.shape[1])] = np.nan
+        return ev
+
+    eng.step = _sick
+
+
+def _inject_routing_corruption(router, seed):
+    """An affinity entry is overwritten to name a replica outside the
+    fleet — the routing-table sweep must raise, not dispatch into the
+    void."""
+    if not router._affinity:
+        raise ChaosBuildError("no affinity entries pinned yet")
+    router._affinity[sorted(router._affinity)[0]] = 99
+
+
+def _inject_duplicate_dispatch(router, seed):
+    """A live rid is submitted straight into a SECOND replica's engine,
+    bypassing the router (a buggy front-end retry). Token-level checks
+    cannot see it — the duplicate's key chain replays the identical
+    stream — so the at-most-once liveness sweep must catch it
+    structurally."""
+    from cs336_systems_tpu.serving.scheduler import Request
+
+    rep = _busiest(router)
+    if not rep.engine.running:
+        raise ChaosBuildError("no running request to duplicate")
+    req = min(rep.engine.running.values(), key=lambda r: r.rid)
+    other = next(r for r in router.replicas
+                 if r.idx != rep.idx and r.state != "quarantined")
+    other.engine.submit(Request(req.rid, np.array(req.prompt), 2,
+                                arrival=0.0))
+
+
+def _inject_stale_affinity(router, seed):
+    """Session A's pinned replica is killed, and the affinity entry is
+    restored to point at the corpse — the completed-session case: drain
+    only re-points entries of LIVE requests, so an entry learned before
+    the quarantine can legitimately outlive its target. A late
+    same-session arrival must be detected as stale at dispatch, logged
+    retriable, and re-routed to a survivor — never an invariant trip."""
+    late = _late_request(seed)
+    akey = router._affinity_key(late.prompt)
+    k0 = router._affinity.get(akey)
+    if k0 is None:
+        raise ChaosBuildError("late-session prefix not pinned yet")
+    router.kill(int(k0), why="injected spill")
+    router._affinity[akey] = int(k0)
+    router.submit(late)
+
+
+def _inject_shed_storm(router, seed):
+    """Every replica crashes at once — zero survivors. The fleet must
+    DEGRADE: every unfinished request fails with the retriable
+    ReplicaUnavailable, run() terminates — never a cliff-hang."""
+
+    def _boom(now=None):
+        raise RuntimeError("injected fleet-wide outage")
+
+    for rep in router.replicas:
+        rep.engine.step = _boom
+
+
+# -- per-fault structural postconditions --------------------------------
+
+
+def _post_failover_complete(router, rids):
+    """>=1 quarantine, and EVERY request still completed (on survivors)."""
+    return (router.quarantines >= 1 and router.failovers >= 1
+            and set(router.results) == set(rids))
+
+
+def _post_shed_storm(router, rids):
+    return (all(rep.state == "quarantined" for rep in router.replicas)
+            and set(router.results) | set(router.failed) == set(rids)
+            and all(e.retriable for e in router.failed.values()))
+
+
+def _post_late_completed(router, rids):
+    return (router.quarantines == 1
+            and set(router.results) == set(rids) | {LATE_RID})
+
+
+# fault -> (injector, expected error classes, message pattern,
+#           needs-late-oracle, structural postcondition)
+FAULTS = {
+    "replica-crash": (
+        _inject_replica_crash, (ReplicaUnavailable,), r"crashed mid-step",
+        False, _post_failover_complete),
+    "replica-hang": (
+        _inject_replica_hang, (ReplicaUnavailable,),
+        r"watchdog tripped", False, _post_failover_complete),
+    "poisoned-replica": (
+        _inject_poisoned_replica, (SlotPoisoned, ReplicaUnavailable),
+        r"non-finite|strikes", False, _post_failover_complete),
+    "routing-corruption": (
+        _inject_routing_corruption, (FleetInvariantViolation,),
+        r"routing table corrupt", False, None),
+    "duplicate-dispatch": (
+        _inject_duplicate_dispatch, (FleetInvariantViolation,),
+        r"live on two replicas", False, None),
+    "stale-affinity": (
+        _inject_stale_affinity, (ReplicaUnavailable,),
+        r"stale affinity", True, _post_late_completed),
+    "shed-storm": (
+        _inject_shed_storm, (ReplicaUnavailable,),
+        r"no surviving replica|no healthy replica", False,
+        _post_shed_storm),
+}
+
+
+def fault_names():
+    return list(FAULTS)
+
+
+# -- the drive loop -----------------------------------------------------
+
+
+def _drive(router, inject=None, seed: int = 0):
+    """Drive the standard fleet trace: PRE_STEPS clean (router
+    self_check MUST stay silent — a raise here is a build error), inject,
+    then step + self_check until a ServingError propagates or every
+    request reaches a terminal state. Returns (raised-or-None, steps)."""
+    t = 0.0
+    for _ in range(PRE_STEPS):
+        router.step(t)
+        t += 1.0
+        router.self_check()  # pre-injection: any raise = build error
+    if inject is not None:
+        inject(router, seed)
+    steps = 0
+    try:
+        router.self_check()
+        for _ in range(MAX_STEPS):
+            if not router._open:
+                break
+            router.step(t)
+            t += 1.0
+            steps += 1
+            router.self_check()
+        else:
+            raise ChaosBuildError(
+                f"fleet did not reach terminal state within {MAX_STEPS} "
+                f"steps — a hang is exactly what the router must prevent")
+        router.check_idle()
+    except ServingError as e:
+        return e, steps
+    return None, steps
+
+
+def _bit_exact(router, oracle) -> bool:
+    """Every completed stream — engine record AND the client-facing
+    delivered cursor — must equal the oracle's tokens bitwise."""
+    for rid, arr in router.results.items():
+        if rid not in oracle:
+            return False
+        if not np.array_equal(np.asarray(arr), oracle[rid]):
+            return False
+        if list(np.asarray(arr)) != router._delivered.get(rid, []):
+            return False
+    return True
+
+
+def _err_dict(err):
+    return None if err is None else {
+        "type": type(err).__name__,
+        "retriable": err.retriable,
+        "shard": err.shard,
+        "message": str(err),
+    }
+
+
+def run_fault(name: str, mesh_name: str = "none", seed: int = 0) -> dict:
+    """Inject fault ``name`` into a fresh standard fleet trace and
+    report the verdict. ``detected`` = the expected typed error surfaced
+    (raised, absorbed into ``router.faults``, or a terminal entry in
+    ``router.failed``); ``ok`` additionally requires bit-exact surviving
+    streams, full request accounting, and the fault's structural
+    postcondition."""
+    if name not in FAULTS:
+        raise ChaosBuildError(f"unknown fault {name!r} (see --list)")
+    inject, expected, pattern, late, post = FAULTS[name]
+    router = _build_fleet(mesh_name, seed)
+    reqs = _build_requests(seed)
+    for r in reqs:
+        router.submit(r)
+    oracle = _oracle_results(seed, include_late=late)
+    raised, steps = _drive(router, inject, seed)
+    # router-state corruption (FleetInvariantViolation) must PROPAGATE —
+    # the fleet is condemned, drain/rebuild is the caller's move, so the
+    # raise IS the verdict and no terminal accounting is possible;
+    # replica failures must be ABSORBED (faults/failed) and fully drain
+    aborts = any(issubclass(c, FleetInvariantViolation) for c in expected)
+    candidates = ([raised] if raised is not None else [])
+    if not aborts:
+        candidates += router.faults + list(router.failed.values())
+    matches = [e for e in candidates
+               if isinstance(e, expected) and re.search(pattern, str(e))]
+    detected = bool(matches)
+    rids = [r.rid for r in reqs]
+    accounted = aborts or (
+        not router._open
+        and set(router.results) | set(router.failed)
+        | set(router.cancelled)
+        >= set(rids))
+    exact = _bit_exact(router, oracle)
+    structural = post is None or post(router, rids)
+    ok = detected and exact and accounted and structural
+    return {
+        "fault": name,
+        "mesh": mesh_name,
+        "seed": seed,
+        "expected": [c.__name__ for c in expected],
+        "pattern": pattern,
+        "detected": detected,
+        "bit_exact": exact,
+        "accounted": accounted,
+        "structural": structural,
+        "ok": bool(ok),
+        "steps_after_injection": steps,
+        "failovers": router.failovers,
+        "quarantines": router.quarantines,
+        "states": router.states(),
+        "completed": len(router.results),
+        "failed": len(router.failed),
+        "error": _err_dict(matches[0] if matches
+                           else (raised if raised is not None
+                                 else (router.faults[0] if router.faults
+                                       else None))),
+    }
+
+
+def run_clean(mesh_name: str = "none", seed: int = 0) -> dict:
+    """The false-positive gate: the un-injected fleet must drain with
+    zero findings, zero failovers/quarantines, every request completed
+    bit-exact, and every replica's pool fully free."""
+    router = _build_fleet(mesh_name, seed)
+    reqs = _build_requests(seed)
+    for r in reqs:
+        router.submit(r)
+    oracle = _oracle_results(seed, include_late=False)
+    raised, steps = _drive(router, None, seed)
+    complete = set(router.results) == {r.rid for r in reqs}
+    exact = _bit_exact(router, oracle)
+    quiet = (raised is None and not router.faults
+             and router.failovers == 0 and router.quarantines == 0)
+    return {
+        "fault": "clean",
+        "mesh": mesh_name,
+        "seed": seed,
+        "detected": not quiet,
+        "bit_exact": exact,
+        "accounted": complete,
+        "structural": True,
+        "ok": bool(quiet and complete and exact),
+        "steps_after_injection": steps,
+        "failovers": router.failovers,
+        "quarantines": router.quarantines,
+        "states": router.states(),
+        "completed": len(router.results),
+        "failed": len(router.failed),
+        "error": _err_dict(raised if raised is not None
+                           else (router.faults[0] if router.faults
+                                 else None)),
+    }
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def _fmt_report(rows: list[dict]) -> str:
+    lines = [
+        f"fleetsan: chaos harness over the standard {N_REPLICAS}-replica "
+        f"two-session trace (mesh={rows[0]['mesh']}, "
+        f"seed={rows[0]['seed']})",
+        f"  {'fault':<20} {'expected':<36} {'caught':<24} verdict",
+    ]
+    for r in rows:
+        caught = "-" if r["error"] is None else r["error"]["type"]
+        if r["fault"] == "clean":
+            verdict = ("clean" if r["ok"]
+                       else "FALSE POSITIVE" if r["detected"]
+                       else "NOT BIT-EXACT" if not r["bit_exact"]
+                       else "INCOMPLETE DRAIN")
+            lines.append(f"  {'clean':<20} {'(zero findings)':<36} "
+                         f"{caught:<24} {verdict}")
+            continue
+        verdict = ("detected" if r["ok"]
+                   else "MISSED" if not r["detected"]
+                   else "NOT BIT-EXACT" if not r["bit_exact"]
+                   else "LOST REQUESTS" if not r["accounted"]
+                   else "BAD POSTCONDITION")
+        lines.append(f"  {r['fault']:<20} {'|'.join(r['expected']):<36} "
+                     f"{caught:<24} {verdict}")
+    n_bad = sum(1 for r in rows if not r["ok"])
+    lines.append("  all detected, survivors bit-exact, clean run clean"
+                 if n_bad == 0 else f"  {n_bad} verdict(s) FAILED")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetsan",
+        description="fleet-router chaos harness: inject fleet-level "
+                    "faults and prove the router surfaces the expected "
+                    "typed error with bit-exact surviving streams")
+    ap.add_argument("--fault", help="single fault to inject (see --list); "
+                                    "default: every fault + the clean run")
+    ap.add_argument("--mesh", default="none", choices=("none", "dp2"),
+                    help="per-replica mesh (default none = single device "
+                         "per replica)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="trace seed (params, prompts, PRNG chains)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list fault classes, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        if args.json:
+            print(json.dumps({"faults": fault_names()}))
+        else:
+            print("fault classes (--fault):")
+            for name in fault_names():
+                print(f"  {name}")
+        return 0
+
+    try:
+        if args.fault:
+            rows = [run_fault(args.fault, args.mesh, args.seed)]
+        else:
+            rows = [run_fault(name, args.mesh, args.seed)
+                    for name in fault_names()]
+            rows.append(run_clean(args.mesh, args.seed))
+    except Exception as e:  # noqa: BLE001 — exit 2 is the build-error gate
+        if args.json:
+            print(json.dumps({"schema": "fleetsan/v1",
+                              "error": f"{type(e).__name__}: {e}"}))
+        else:
+            traceback.print_exc()
+            print(f"fleetsan: BUILD/RUN ERROR: {type(e).__name__}: {e}")
+        return 2
+
+    print(json.dumps({"schema": "fleetsan/v1", "rows": rows})
+          if args.json else _fmt_report(rows))
+    return 0 if all(r["ok"] for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

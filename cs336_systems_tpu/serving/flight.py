@@ -58,8 +58,16 @@ class FlightRecorder:
     no-op (the A/B twin for the bit-identity test) without changing the
     engine's clock-read pattern."""
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, replica: int | None = None):
         self.enabled = enabled
+        # fleet tag (ISSUE 14): when the engine is one replica of a
+        # FleetRouter the router stamps its index here, and every event
+        # and step record carries a "replica" field; ``None`` (the
+        # single-engine default) keeps the records byte-identical to
+        # pre-fleet logs, so committed servetrace artifacts fold and
+        # --diff unchanged. Survives ``reset()`` — the identity of the
+        # replica does not change when its log is cleared.
+        self.replica = replica
         self.reset()
 
     def reset(self) -> None:
@@ -75,8 +83,10 @@ class FlightRecorder:
 
     def event(self, kind: str, rid, t: float, **fields) -> None:
         if self.enabled:
-            self.events.append({"kind": kind, "rid": rid, "t": t,
-                                **fields})
+            rec = {"kind": kind, "rid": rid, "t": t, **fields}
+            if self.replica is not None:
+                rec["replica"] = self.replica
+            self.events.append(rec)
 
     # -- per-step phase spans ----------------------------------------
 
@@ -87,6 +97,8 @@ class FlightRecorder:
             self._cur = {"i": i, "t0": t0,
                          "phases": dict.fromkeys(PHASES, 0.0),
                          "emits": [], "evicts": []}
+            if self.replica is not None:
+                self._cur["replica"] = self.replica
 
     def span(self, phase: str, t0: float, t1: float) -> None:
         """Accumulate ``t1 - t0`` into the open step's phase. Non-finite

@@ -1,0 +1,641 @@
+"""Fleet router: multi-replica serving with prefix-affinity dispatch and
+mid-stream failover (ISSUE 14).
+
+The continuous-batching engine (engine.py) is chaos-hardened but
+SINGULAR: one slot batch, one page pool, one failure domain. This module
+is the first cross-replica scheduler decision in the repo — a
+``FleetRouter`` over N independent ``ServingEngine`` replicas (the
+replica-pool topology of the Gemma-on-TPU serving comparison point,
+PAPERS.md), built on three contracts the single engine already pins:
+
+- PREFIX-AFFINITY DISPATCH. The prefix cache (ISSUE 9) is shard-local by
+  design, so the fleet-level hit rate is a ROUTING property: requests
+  sharing a page-aligned prefix must land on the replica that already
+  holds its KV. The affinity key is the PrefixCache chain hash of the
+  request's FIRST full block (the same ``chain_hashes`` the trie is
+  keyed by — params fingerprint included, so two fleets never alias);
+  first sight of a key pins it to the least-loaded eligible replica,
+  later requests follow it. Cold prefixes (or ``policy="least-loaded"`` /
+  ``"random"``) fall back to load balance. An affinity entry pointing at
+  a replica that has since been quarantined is a TRANSIENT: dispatch
+  logs a retriable ``ReplicaUnavailable`` and re-pins — never an
+  invariant violation (that is reserved for entries naming an index
+  outside the fleet).
+
+- HEALTH STATE MACHINE, driven by the typed ServingError surface
+  (ISSUE 10): healthy → degraded → quarantined. Every error a replica's
+  ``step()``/``self_check()``/containment surfaces is absorbed as a
+  STRIKE (logged in ``faults``); a degraded replica takes no NEW
+  dispatches but keeps streaming; ``quarantine_after`` strikes — or a
+  crash (non-ServingError escaping ``step``), or the dispatch WATCHDOG
+  (a replica with running slots that produces zero events for
+  ``watchdog_steps`` consecutive steps; a healthy engine emits or
+  finishes every running slot every step, so silence IS the hang
+  signal) — quarantines it: the replica is drained (best-effort cancel
+  frees its pages) and never stepped again. ``heal_after`` consecutive
+  clean steps walk a degraded replica back to healthy.
+
+- MID-STREAM FAILOVER, bit-exact. A request's stream is a pure function
+  of (params, base key, row, prompt) — the per-slot key chain resets to
+  the engine's base key at join and folds in the request's global row
+  (engine.py), so EVERY replica of a fleet built with the same base key
+  produces the identical stream for a given request. On quarantine the
+  router re-dispatches each in-flight request to a survivor as a fresh
+  clone (same rid/row/prompt/arrival) that replays from the prompt; the
+  AT-MOST-ONCE EMIT CURSOR (``_on_token``) verifies the replayed tokens
+  against the already-delivered prefix token by token — a divergence is
+  a torn stream, ``FleetInvariantViolation`` — and forwards only the
+  extension, so a client callback never sees a duplicated or torn
+  stream. Zero survivors is the shed-storm: every pending request fails
+  with a retriable ``ReplicaUnavailable`` and ``run()`` terminates —
+  proportional degradation through the existing AdmissionPolicy
+  machinery, never a cliff or a hang.
+
+Everything here is host-side control plane: the router never builds a
+jit program, never adds a collective, and never touches the replicas'
+step executables — the serve_engine/serve_engine_prefix lint contracts
+hold verbatim, and a 1-replica router with affinity off drives the
+engine through the exact same submit/step sequence as calling it
+directly (tests/test_fleet_router.py pins byte-identity). The proof of
+the failure semantics is fleetsan (fleet_chaos.py — ``python -m
+cs336_systems_tpu.serving.fleet_chaos``), the gradsan/servesan-shaped
+chaos harness that injects each fleet-level fault class and requires
+the expected typed error AND surviving streams bit-exact to the
+single-replica oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import time as _time
+
+import numpy as np
+
+from cs336_systems_tpu.serving.engine import ServingEngine
+from cs336_systems_tpu.serving.errors import (
+    AdmissionImpossible,
+    DeadlineExceeded,
+    FleetInvariantViolation,
+    ReplicaUnavailable,
+    ServingError,
+)
+from cs336_systems_tpu.serving.flight import FlightRecorder
+from cs336_systems_tpu.serving.scheduler import Request
+
+POLICIES = ("affinity", "least-loaded", "random")
+
+
+class _Replica:
+    """Per-replica health record. ``state``: healthy (dispatchable) →
+    degraded (streams, no new dispatches) → quarantined (drained, never
+    stepped again). ``idle``: consecutive steps with running slots but
+    zero events — the watchdog counter."""
+
+    __slots__ = ("engine", "idx", "state", "strikes", "idle", "clean")
+
+    def __init__(self, engine: ServingEngine, idx: int):
+        self.engine = engine
+        self.idx = idx
+        self.state = "healthy"
+        self.strikes = 0
+        self.idle = 0
+        self.clean = 0
+
+
+class FleetRouter:
+    """Route requests over N independent ``ServingEngine`` replicas.
+
+    ``engines``: the replicas — same config, same ``page_block``, and
+    (checked) the SAME base PRNG key, which is what makes a failed-over
+    stream bit-identical to the original replica's. ``policy``: one of
+    ``POLICIES`` (affinity = chain-hash pinning with least-loaded
+    fallback). ``on_token(rid, tok)``: client callback, called exactly
+    once per delivered token fleet-wide (the at-most-once cursor);
+    the router OWNS every replica's ``on_token`` hook. ``on_step``:
+    optional hook called at each ``step()`` entry with the router (the
+    benchmark's kill-mid-trace seam). Mirrors the engine surface the
+    benchmark driver consumes: ``submit``/``step``/``run``/``cancel``/
+    ``results``/``failed``/``cancelled``/``check_idle``/``self_check``
+    plus the summed prefix-cache telemetry."""
+
+    def __init__(self, engines: list[ServingEngine], *,
+                 policy: str = "affinity", watchdog_steps: int = 4,
+                 quarantine_after: int = 3, max_redispatch: int = 3,
+                 heal_after: int = 16, seed: int = 0,
+                 on_token=None, on_step=None, flight: bool = True):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one replica")
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; one of {POLICIES}")
+        base = engines[0]
+        for k, eng in enumerate(engines):
+            if not np.array_equal(eng.base_key, base.base_key):
+                raise ValueError(
+                    f"replica {k} has a different base key — failover "
+                    f"streams would not be bit-identical")
+            if eng.page_block != base.page_block:
+                raise ValueError(
+                    f"replica {k}: page_block {eng.page_block} != "
+                    f"{base.page_block} — affinity keys would not agree")
+        self.replicas = [_Replica(eng, k) for k, eng in enumerate(engines)]
+        self.policy = policy
+        self.watchdog_steps = int(watchdog_steps)
+        self.quarantine_after = int(quarantine_after)
+        self.max_redispatch = int(max_redispatch)
+        self.heal_after = int(heal_after)
+        self.on_token = on_token
+        self.on_step = on_step
+        self.clock = base.clock
+        self._rng = np.random.default_rng(seed)
+        self.flight = FlightRecorder(enabled=flight)
+        for k, eng in enumerate(engines):
+            eng.flight.replica = k
+            eng.on_token = (lambda rid, tok, _k=k:
+                            self._on_token(_k, rid, tok))
+
+        # fleet-level request state
+        self._requests: dict[int, Request] = {}   # rid -> ORIGINAL request
+        self._cur_req: dict[int, Request] = {}    # rid -> live (orig/clone)
+        self._where: dict[int, int] = {}          # rid -> assigned replica
+        self._open: set[int] = set()              # submitted, not terminal
+        self._tries: dict[int, int] = {}          # rid -> dispatch count
+        # the at-most-once emit cursor: delivered tokens + per-(rid,
+        # replica) stream positions; a replayed token must EQUAL the
+        # delivered one at its position, only the extension forwards
+        self._delivered: dict[int, list[int]] = {}
+        self._emit_t: dict[int, list[float]] = {}
+        self._seen: dict[tuple[int, int], int] = {}
+        self._affinity: dict[bytes, int] = {}
+
+        self.results: dict[int, np.ndarray] = {}
+        self.failed: dict[int, ServingError] = {}
+        self.cancelled: dict[int, np.ndarray] = {}
+        self.faults: list[ServingError] = []  # every absorbed strike
+        self.failovers = 0
+        self.quarantines = 0
+        self.rounds = 0        # router step() invocations
+        self._now = 0.0
+
+    # -- aggregate telemetry (benchmarks/serving.run_cell columns) -----
+
+    @property
+    def engines(self) -> list[ServingEngine]:
+        return [rep.engine for rep in self.replicas]
+
+    @property
+    def steps(self) -> int:
+        return sum(rep.engine.steps for rep in self.replicas)
+
+    @property
+    def slots(self) -> int:
+        return sum(rep.engine.slots for rep in self.replicas)
+
+    @property
+    def dp(self) -> int:
+        return self.replicas[0].engine.dp
+
+    @property
+    def running(self) -> dict:
+        """Union of replica running maps, keyed (replica, slot) — only
+        servetrace's live-token conservation reads it."""
+        out = {}
+        for rep in self.replicas:
+            for slot, req in rep.engine.running.items():
+                out[(rep.idx, slot)] = req
+        return out
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return sum(r.engine.prefix_hit_tokens for r in self.replicas)
+
+    @property
+    def prefix_prompt_tokens(self) -> int:
+        return sum(r.engine.prefix_prompt_tokens for r in self.replicas)
+
+    @property
+    def prefill_tokens(self) -> int:
+        return sum(r.engine.prefill_tokens for r in self.replicas)
+
+    @property
+    def shared_kv_bytes_peak(self) -> int:
+        return sum(r.engine.shared_kv_bytes_peak for r in self.replicas)
+
+    def states(self) -> list[str]:
+        return [rep.state for rep in self.replicas]
+
+    # -- dispatch ------------------------------------------------------
+
+    def _affinity_key(self, prompt: np.ndarray) -> bytes | None:
+        """Chain hash of the first FULL page-aligned block — the exact
+        key the replica tries are keyed by (params fingerprint folded
+        in), so affinity agrees with what lookup() will actually hit.
+        Prompts shorter than one block have no cacheable prefix: None →
+        least-loaded fallback."""
+        eng0 = self.replicas[0].engine
+        if prompt.size < eng0.page_block:
+            return None
+        if eng0.prefix_caches is not None:
+            hashes = eng0.prefix_caches[0].chain_hashes(prompt)
+            if hashes:
+                return hashes[0]
+        return hashlib.blake2b(
+            np.asarray(prompt[:eng0.page_block], np.int32).tobytes(),
+            digest_size=16).digest()
+
+    def _load(self, k: int) -> int:
+        eng = self.replicas[k].engine
+        return len(eng.scheduler) + len(eng.running)
+
+    def _eligible(self, exclude: int | None = None) -> list[int]:
+        """Dispatch targets: healthy replicas first; if none, degraded
+        (still streaming) beats shedding; quarantined never."""
+        for states in (("healthy",), ("healthy", "degraded")):
+            ok = [rep.idx for rep in self.replicas
+                  if rep.state in states and rep.idx != exclude]
+            if ok:
+                return ok
+        return []
+
+    def _pick(self, key: bytes | None, exclude: int | None = None) -> int | None:
+        """Choose a replica for (re-)dispatch; None = no survivor."""
+        eligible = self._eligible(exclude)
+        if not eligible:
+            return None
+        if self.policy == "random":
+            return int(eligible[self._rng.integers(len(eligible))])
+        least = min(eligible, key=lambda k: (self._load(k), k))
+        if self.policy != "affinity" or key is None:
+            return least
+        pinned = self._affinity.get(key)
+        if pinned is not None and pinned in eligible:
+            return pinned
+        if pinned is not None and 0 <= pinned < len(self.replicas):
+            # stale affinity: the pinned replica was quarantined (or is
+            # the excluded faulty one) after the key was pinned — a
+            # transient, re-routed with a logged retriable error; the
+            # out-of-range case is FleetInvariantViolation in self_check
+            self._log_fault(ReplicaUnavailable(
+                f"stale affinity entry {key.hex()[:8]}: pinned replica "
+                f"is {self.replicas[pinned].state} — re-routing to "
+                f"replica {least}", replica=pinned))
+        self._affinity[key] = least
+        return least
+
+    def _log_fault(self, err: ServingError) -> None:
+        self.faults.append(err)
+
+    def submit(self, req: Request) -> None:
+        """Route and queue a request on one replica. Raises the
+        replica's ``AdmissionImpossible`` verbatim (nothing was
+        registered), or a retriable ``ReplicaUnavailable`` when the
+        whole fleet is quarantined."""
+        if req.rid in self._open:
+            raise AdmissionImpossible(
+                f"request {req.rid} is already live in the fleet "
+                f"(duplicate rid)")
+        key = (self._affinity_key(req.prompt)
+               if self.policy == "affinity" else None)
+        k = self._pick(key)
+        if k is None:
+            raise ReplicaUnavailable(
+                f"request {req.rid}: no healthy replica in the fleet "
+                f"({len(self.replicas)} quarantined) — resubmit when a "
+                f"replica recovers")
+        self.replicas[k].engine.submit(req)
+        self._requests[req.rid] = req
+        self._cur_req[req.rid] = req
+        self._where[req.rid] = k
+        self._open.add(req.rid)
+        self._tries[req.rid] = 1
+        self._seen[(req.rid, k)] = 0
+        self._delivered.setdefault(req.rid, [])
+        self._emit_t.setdefault(req.rid, [])
+        self.flight.event("dispatch", req.rid, float(req.arrival),
+                          replica=k)
+
+    # -- the at-most-once emit cursor ---------------------------------
+
+    def _on_token(self, k: int, rid: int, tok: int) -> None:
+        """Every replica token lands here. Position ``pos`` of replica
+        k's stream for ``rid``: below the delivered cursor it is a
+        REPLAY and must match bit-for-bit (else the stream tore); at the
+        cursor it extends and forwards to the client exactly once."""
+        pos = self._seen.get((rid, k), 0)
+        self._seen[(rid, k)] = pos + 1
+        delivered = self._delivered.setdefault(rid, [])
+        if pos < len(delivered):
+            if tok != delivered[pos]:
+                raise FleetInvariantViolation(
+                    f"rid {rid}: replayed token at position {pos} on "
+                    f"replica {k} is {tok}, already delivered "
+                    f"{delivered[pos]} — torn stream")
+            return  # replay of an already-delivered token: suppressed
+        delivered.append(int(tok))
+        req = self._cur_req.get(rid)
+        self._emit_t.setdefault(rid, []).append(
+            req.emit_times[-1] if req is not None and req.emit_times
+            else self._now)
+        if self.on_token is not None:
+            self.on_token(rid, tok)
+
+    # -- health machine / failover ------------------------------------
+
+    def _strike(self, k: int, err: ServingError) -> None:
+        rep = self.replicas[k]
+        self._log_fault(err)
+        rep.strikes += 1
+        rep.clean = 0
+        if rep.state == "healthy":
+            rep.state = "degraded"
+        if rep.strikes >= self.quarantine_after:
+            self._quarantine(k, ReplicaUnavailable(
+                f"quarantined after {rep.strikes} strikes "
+                f"(last: {type(err).__name__}: {err})", replica=k))
+
+    def _quarantine(self, k: int, err: ReplicaUnavailable) -> None:
+        """Quarantine + drain: mark the replica dead, best-effort cancel
+        its live requests (frees pages on a host-side-intact engine) and
+        fail them over to survivors."""
+        rep = self.replicas[k]
+        if rep.state == "quarantined":
+            return
+        rep.state = "quarantined"
+        self.quarantines += 1
+        self._log_fault(err)
+        self.flight.event("quarantine", None, self._now, replica=k,
+                          error=err.detail)
+        live = [r.rid for r in rep.engine.running.values()]
+        live += [r.rid for _, _, r in rep.engine.scheduler._queue]
+        for rid in live:
+            try:
+                rep.engine.cancel(rid, self._now)
+            except Exception:  # noqa: BLE001 — the replica is dead; its
+                pass           # allocator may be beyond a clean eviction
+            if rid in self._open and self._where.get(rid) == k:
+                self._redispatch(rid, exclude=k,
+                                 why=f"replica {k} quarantined")
+
+    def _redispatch(self, rid: int, exclude: int, why: str) -> None:
+        """Fail a live request over to a survivor: a fresh clone (same
+        rid/row/prompt/arrival — the key-chain identity) replays from
+        the prompt; the emit cursor suppresses the replayed prefix."""
+        if rid not in self._open:
+            return
+        orig, cur = self._requests[rid], self._cur_req[rid]
+        delivered = self._delivered.get(rid, [])
+        if self._tries.get(rid, 0) > self.max_redispatch:
+            self._finalize_failure(rid, ReplicaUnavailable(
+                f"request {rid}: gave up after "
+                f"{self._tries[rid]} dispatches ({why})"))
+            return
+        key = (self._affinity_key(orig.prompt)
+               if self.policy == "affinity" else None)
+        target = self._pick(key, exclude=exclude)
+        if target is None:
+            self._finalize_failure(rid, ReplicaUnavailable(
+                f"request {rid}: no surviving replica to fail over to "
+                f"({why}) — shed"))
+            return
+        if key is not None:
+            self._affinity[key] = target
+        clone = Request(rid=rid, prompt=np.array(orig.prompt),
+                        max_new_tokens=orig.max_new_tokens,
+                        arrival=orig.arrival, row=orig.row,
+                        deadline=orig.deadline, priority=orig.priority)
+        # progress made so far folds into the original's record before
+        # the clone takes over (the clone's replay re-verifies it)
+        if cur is not orig:
+            orig.tokens = list(delivered)
+            orig.emit_times = list(self._emit_t.get(rid, []))
+        self.replicas[target].engine.submit(clone)
+        self._cur_req[rid] = clone
+        self._where[rid] = target
+        self._tries[rid] = self._tries.get(rid, 0) + 1
+        self._seen[(rid, target)] = 0
+        self.failovers += 1
+        self.flight.event("failover", rid, self._now, replica=target,
+                          source=exclude, delivered=len(delivered),
+                          why=why)
+
+    def _close(self, rid: int) -> None:
+        self._open.discard(rid)
+
+    def _finalize_success(self, rid: int, k: int) -> None:
+        req, orig = self._cur_req[rid], self._requests[rid]
+        if req is not orig:
+            # graft the clone's stream back onto the caller's Request:
+            # delivered tokens with their ORIGINAL first-delivery stamps
+            orig.tokens = list(self._delivered.get(rid, []))
+            orig.emit_times = list(self._emit_t.get(rid, []))
+            orig.finish_time = req.finish_time
+        self.results[rid] = self.replicas[k].engine.results[rid]
+        self._close(rid)
+
+    def _finalize_failure(self, rid: int, err: ServingError) -> None:
+        req, orig = self._cur_req.get(rid), self._requests.get(rid)
+        if req is not None and orig is not None and req is not orig:
+            orig.tokens = list(self._delivered.get(rid, []))
+            orig.emit_times = list(self._emit_t.get(rid, []))
+            orig.finish_time = req.finish_time
+        self.failed[rid] = err
+        self.flight.event("shed", rid, self._now,
+                          error=type(err).__name__)
+        self._close(rid)
+
+    # -- the fleet step ------------------------------------------------
+
+    def step(self, now: float | None = None) -> list:
+        """Step every non-quarantined replica once and merge their
+        events (replica order — a 1-replica fleet returns the engine's
+        event list verbatim). Absorbs replica failures into the health
+        machine; only ``FleetInvariantViolation`` (a torn stream /
+        corrupt router state) propagates."""
+        if now is None:
+            now = self.clock() if self.clock is not None else math.inf
+        self._now = now
+        self.rounds += 1
+        if self.on_step is not None:
+            self.on_step(self)
+        events = []
+        for rep in self.replicas:
+            if rep.state == "quarantined":
+                continue
+            k, eng = rep.idx, rep.engine
+            try:
+                ev = eng.step(now)
+            except FleetInvariantViolation:
+                raise  # router-level corruption: never absorbed
+            except ServingError as e:
+                self._strike(k, e)
+                continue
+            except Exception as e:  # noqa: BLE001 — replica crash
+                self._quarantine(k, ReplicaUnavailable(
+                    f"crashed mid-step: {type(e).__name__}: {e}",
+                    replica=k))
+                continue
+            # dispatch watchdog: a healthy engine emits or finishes
+            # every running slot every step — running slots with zero
+            # events IS the hang signal
+            if eng.running and not ev:
+                rep.idle += 1
+                if rep.idle >= self.watchdog_steps:
+                    self._quarantine(k, ReplicaUnavailable(
+                        f"hung: {len(eng.running)} running slot(s) "
+                        f"produced no events for {rep.idle} consecutive "
+                        f"steps — dispatch watchdog tripped", replica=k))
+                    continue
+            else:
+                rep.idle = 0
+            events.extend(ev)
+            self._collect(rep)
+            if rep.state == "quarantined":
+                continue
+            try:
+                eng.self_check()
+            except FleetInvariantViolation:
+                raise
+            except ServingError as e:
+                self._strike(k, e)
+                continue
+            rep.clean += 1
+            if rep.state == "degraded" and rep.clean >= self.heal_after:
+                rep.state, rep.strikes = "healthy", 0
+        return events
+
+    def _collect(self, rep: _Replica) -> None:
+        """Harvest the replica's terminal outcomes: completions close
+        out; retriable containment failures (``SlotPoisoned``) strike
+        the replica and fail the request over; policy sheds
+        (``DeadlineExceeded``) and non-retriable request errors are
+        FINAL — the shed is the admission control working, not a
+        replica fault."""
+        k, eng = rep.idx, rep.engine
+        for rid, err in list(eng.failed.items()):
+            if rid not in self._open or self._where.get(rid) != k:
+                continue
+            if isinstance(err, DeadlineExceeded) or not err.retriable:
+                self._finalize_failure(rid, err)
+                continue
+            eng.failed.pop(rid)  # absorbed: the router owns the retry
+            self._strike(k, err)
+            self._redispatch(rid, exclude=k,
+                             why=f"{type(err).__name__} on replica {k}")
+        for rid in list(eng.results):
+            if rid in self._open and self._where.get(rid) == k:
+                self._finalize_success(rid, k)
+
+    def cancel(self, rid: int, now: float | None = None) -> bool:
+        """Client cancel: delegate to the assigned replica; the partial
+        stream (delivered tokens only) lands in ``cancelled[rid]``."""
+        if rid not in self._open:
+            return False
+        k = self._where[rid]
+        try:
+            self.replicas[k].engine.cancel(rid, now)
+        except Exception:  # noqa: BLE001 — cancel on a sick replica
+            pass
+        self.cancelled[rid] = np.asarray(
+            self._delivered.get(rid, []), np.int32)
+        self._close(rid)
+        return True
+
+    # -- drive / invariants -------------------------------------------
+
+    def _shed_all(self) -> None:
+        for rid in sorted(self._open):
+            self._finalize_failure(rid, ReplicaUnavailable(
+                f"request {rid}: no healthy replica in the fleet — shed"))
+
+    def run(self, time_fn=None) -> dict[int, np.ndarray]:
+        """Drive steps until every submitted request reaches a terminal
+        state; returns ``results``. Same clock semantics as
+        ``ServingEngine.run``. Terminates under TOTAL fleet loss (every
+        replica quarantined): remaining requests shed with the retriable
+        ``ReplicaUnavailable`` — capacity loss degrades to rejections,
+        never a hang."""
+        while self._open:
+            alive = [rep for rep in self.replicas
+                     if rep.state != "quarantined"]
+            if not alive:
+                self._shed_all()
+                break
+            if time_fn is not None:
+                now = time_fn()
+            elif self.clock is not None:
+                now = self.clock()
+            else:
+                now = math.inf
+            if not any(rep.engine.running for rep in alive):
+                heads = [rep.engine.scheduler.head(now) for rep in alive]
+                if not any(h is not None for h in heads):
+                    nxt = [rep.engine.scheduler.next_arrival()
+                           for rep in alive]
+                    nxt = [x for x in nxt if x is not None]
+                    if not nxt:
+                        # open rids but no queued or running work on any
+                        # live replica: unreachable state — shed rather
+                        # than spin forever
+                        self._shed_all()
+                        break
+                    if self.clock is not None and time_fn is None:
+                        _time.sleep(min(max(min(nxt) - now, 0.0), 0.05))
+                        continue
+                    now = min(nxt)
+            self.step(now)
+        return self.results
+
+    def kill(self, k: int, why: str = "operator kill") -> None:
+        """Forcibly quarantine replica ``k`` (the benchmark's
+        replica-kill-mid-trace seam): drains and fails its requests over
+        exactly as a detected crash would."""
+        self._quarantine(k, ReplicaUnavailable(why, replica=k))
+
+    def check_idle(self) -> None:
+        """Drained-fleet leak gate: every NON-quarantined replica's pool
+        fully free (quarantined replicas were best-effort drained; their
+        engines are outside the trust boundary by definition)."""
+        for rep in self.replicas:
+            if rep.state != "quarantined":
+                rep.engine.check_idle()
+
+    def self_check(self) -> None:
+        """Fleet-level invariant sweep (the fleetsan detector surface —
+        replica-LOCAL invariants are swept by each replica's own
+        ``self_check`` inside ``step``):
+
+        1. at-most-once dispatch: no rid live (queued or running) on two
+           non-quarantined replicas → ``FleetInvariantViolation``
+        2. routing-table integrity: every affinity entry names a replica
+           index inside the fleet → ``FleetInvariantViolation``
+        3. assignment coherence: every open rid's assigned replica is in
+           range → ``FleetInvariantViolation``
+        """
+        seen: dict[int, int] = {}
+        for rep in self.replicas:
+            if rep.state == "quarantined":
+                continue
+            live = [r.rid for r in rep.engine.running.values()]
+            live += [r.rid for _, _, r in rep.engine.scheduler._queue]
+            for rid in live:
+                if rid in seen and seen[rid] != rep.idx:
+                    raise FleetInvariantViolation(
+                        f"rid {rid} is live on two replicas "
+                        f"({seen[rid]} and {rep.idx}) — duplicate "
+                        f"dispatch; the at-most-once emit contract is "
+                        f"about to tear")
+                seen[rid] = rep.idx
+        n = len(self.replicas)
+        for key, target in self._affinity.items():
+            if not (isinstance(target, (int, np.integer))
+                    and 0 <= target < n):
+                raise FleetInvariantViolation(
+                    f"affinity entry {key.hex()[:8]} names replica "
+                    f"{target!r}, outside the {n}-replica fleet — "
+                    f"routing table corrupt")
+        for rid in self._open:
+            k = self._where.get(rid)
+            if k is None or not 0 <= k < n:
+                raise FleetInvariantViolation(
+                    f"open rid {rid} assigned to replica {k!r}, outside "
+                    f"the {n}-replica fleet")

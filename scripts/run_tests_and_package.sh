@@ -288,6 +288,55 @@ if [ "$trainsan_status" -eq 0 ]; then
 fi
 [ "$status" -eq 0 ] && status=$trainsan_status
 
+# fleetsan gate (ISSUE 14): the fleet-router chaos harness — every
+# seeded fleet-level fault (replica crash/hang/poison, routing-table
+# corruption, duplicate dispatch, stale affinity, shed storm) must
+# surface its expected typed serving error with surviving streams
+# bit-exact to the single-replica oracle (exit 0 per fault; a missed or
+# misclassified detection 1, a broken fleet build 2), then the clean
+# fleet must drain with zero findings. Iterates --list so a fault class
+# added to serving/fleet_chaos.py is gated automatically.
+fleetsan_status=0
+for fault in $(JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+        python -m cs336_systems_tpu.serving.fleet_chaos --list --json \
+        | python -c "import json,sys; print(' '.join(json.load(sys.stdin)['faults']))"); do
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.serving.fleet_chaos --fault "$fault" --json \
+        > "/tmp/fleetsan_$fault.json" \
+        || { fleetsan_status=$?; echo "fleetsan: fault $fault FAILED" >&2; }
+done
+if [ "$fleetsan_status" -eq 0 ]; then
+    # matrix parity: the full run (all faults + clean) with dp2-sharded
+    # replicas — the router is host-side control plane, so verdicts must
+    # be identical when each replica's step program is sharded
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.serving.fleet_chaos --mesh dp2 --json \
+        > /tmp/fleetsan_dp2.json
+    fleetsan_status=$?
+fi
+if [ "$fleetsan_status" -eq 0 ]; then
+    # replica-kill-mid-trace recovery smoke through the REAL benchmark
+    # driver: kill 1 of 3 replicas mid-trace and require every request
+    # to still complete (ample survivor capacity → failovers, not sheds)
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.benchmarks.serving --test-model \
+        --requests 10 --loads 20 --new 6 --replicas 3 --router affinity \
+        --kill-replica-at 3 --out /tmp/fleet_kill_smoke.jsonl
+    fleetsan_status=$?
+fi
+if [ "$fleetsan_status" -eq 0 ]; then
+    python - <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open("/tmp/fleet_kill_smoke.jsonl")]
+bad = [r["name"] for r in rows
+       if r["shed"] != 0 or r["completed"] != r["requests"]
+       or r["failovers"] < 1 or r["quarantines"] != 1]
+sys.exit(1 if bad or not rows else 0)
+EOF
+    fleetsan_status=$?
+fi
+[ "$status" -eq 0 ] && status=$fleetsan_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -66,6 +66,22 @@ def test_readme_list_count_claims():
             assert int(n) == live[cli], (cli, n)
 
 
+def test_fleetsan_fault_count_claims():
+    # ISSUE 14 satellite: the "(N seeded fault classes" claim in the
+    # CLAUDE.md fleetsan block and the analysis/README detection matrix
+    # must match what fleet_chaos actually registers — a fault class
+    # added without touching the docs (or vice-versa) fails here
+    from cs336_systems_tpu.serving import fleet_chaos
+
+    live = len(fleet_chaos.fault_names())
+    m = re.search(r"injects (\d+) seeded fleet-level fault", CLAUDE_MD)
+    assert m, "CLAUDE.md fleetsan block lost its fault-count claim"
+    assert int(m.group(1)) == live
+    m = re.search(r"fleetsan.*?(\d+) fault classes", README, re.S)
+    assert m, "analysis/README.md fleetsan section lost its fault count"
+    assert int(m.group(1)) == live
+
+
 def test_lint_registry_matches_serve_and_train_families():
     # the lint registry = the 17 traced families + the kernel-level
     # gmm_fused_bwd step (README: "minus the kernel-level gmm_fused_bwd")

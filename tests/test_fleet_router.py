@@ -1,0 +1,441 @@
+"""Fleet router (ISSUE 14): prefix-affinity dispatch, the health state
+machine, mid-stream failover, and the fleetsan fault matrix.
+
+The load-bearing properties, in the order they compose:
+
+1. TRANSPARENCY — a 1-replica router with affinity off drives the
+   engine through the exact same submit/step sequence as calling it
+   directly: per-step event lists and final results byte-identical (the
+   router is pure host-side control plane; the jit step program is
+   pinned separately by the serve_engine lint families).
+2. AFFINITY — same-prefix sessions land on the replica that already
+   holds the KV (the trie is shard-local, so the fleet hit rate is a
+   routing property).
+3. FAILOVER BIT-EXACTNESS — a stream is a pure function of (params,
+   base key, row, prompt), so a request replayed on a survivor after a
+   mid-stream kill produces the identical tokens, verified against the
+   row-keyed oracle ``generate_kv_batched(row_keyed=True)`` — the same
+   oracle discipline as tests/test_serving_engine.py — and the
+   at-most-once emit cursor delivers each token to the client exactly
+   once across the replay.
+4. DEGRADATION — zero survivors sheds every request with the retriable
+   typed error; ``run()`` terminates, never hangs.
+5. The fleetsan matrix (serving/fleet_chaos.py): every seeded
+   fleet-level fault surfaces its expected typed error with bit-exact
+   survivors, on single-device and dp2-per-replica meshes alike.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.models.decode import generate_kv_batched
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.serving import (
+    FleetInvariantViolation,
+    FleetRouter,
+    ReplicaUnavailable,
+    Request,
+    ServingEngine,
+    fleet_chaos,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 8
+N_REQ = 6
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    """One shared full-block session prefix + distinct 4-token tails —
+    the affinity-routable shape (every prompt shares its first chain
+    hash)."""
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(0, CFG.vocab_size, size=BLK)
+    return [np.concatenate([prefix, rng.integers(0, CFG.vocab_size,
+                                                 size=4)]).astype(np.int32)
+            for _ in range(N_REQ)]
+
+
+@pytest.fixture(scope="module")
+def oracle(params, prompts):
+    padded = np.zeros((len(prompts), BLK + 4), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :p.size] = p
+    return np.asarray(generate_kv_batched(
+        params, CFG, padded, NEW, jax.random.PRNGKey(0), temperature=0.9,
+        top_k=8, row_keyed=True, prompt_lens=[p.size for p in prompts],
+        page_block=BLK))
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=4, n_pages=16,
+                max_blocks=4, page_block=BLK, temperature=0.9, top_k=8)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+def _requests(prompts):
+    return [Request(i, np.array(p), max_new_tokens=NEW, arrival=0.0)
+            for i, p in enumerate(prompts)]
+
+
+def _tick():
+    it = iter(np.arange(0.0, 1e4, 0.5))
+    return lambda: float(next(it))
+
+
+# --- 1-replica transparency --------------------------------------------
+
+
+def test_single_replica_byte_identical_to_direct_engine(params, prompts):
+    """Same virtual clock, same requests: the per-step event sequences
+    and final results of router(1 replica, affinity off) and the bare
+    engine must be identical — the router adds decisions only when there
+    is more than one replica to decide between."""
+    direct = _engine(params)
+    routed = FleetRouter([_engine(params)], policy="least-loaded")
+    for r in _requests(prompts):
+        direct.submit(r)
+    for r in _requests(prompts):
+        routed.submit(r)
+    t = 0.0
+    for _ in range(64):
+        ev_d = direct.step(t)
+        ev_r = routed.step(t)
+        assert ev_d == ev_r, f"step events diverged at t={t}"
+        t += 1.0
+        if not direct.running and not len(direct.scheduler):
+            break
+    assert set(direct.results) == set(routed.results)
+    for rid in direct.results:
+        assert np.array_equal(np.asarray(direct.results[rid]),
+                              np.asarray(routed.results[rid]))
+    assert routed.failovers == 0 and routed.quarantines == 0
+    direct.check_idle()
+    routed.check_idle()
+    routed.self_check()
+
+
+# --- prefix-affinity dispatch ------------------------------------------
+
+
+def test_affinity_pins_sessions_and_balances_cold(params):
+    """Two sessions over three replicas: every session-A request lands
+    on the replica that admitted session A's first request (warm KV),
+    session B on a different one (least-loaded at first sight), and the
+    third replica serves nothing."""
+    rng = np.random.default_rng(11)
+    pref_a = rng.integers(0, CFG.vocab_size, size=BLK)
+    pref_b = rng.integers(0, CFG.vocab_size, size=BLK)
+    reqs = []
+    for i in range(8):
+        pref = pref_a if i % 2 == 0 else pref_b
+        prompt = np.concatenate(
+            [pref, rng.integers(0, CFG.vocab_size, size=4)]).astype(np.int32)
+        # staggered arrivals so each session's first prefill PUBLISHES
+        # before the next member admits (simultaneous admits are all
+        # cold by construction); pinning is submit-order based, so the
+        # homes are deterministic either way
+        reqs.append(Request(i, prompt, max_new_tokens=4,
+                            arrival=float(i) * 5.0))
+    router = FleetRouter([_engine(params) for _ in range(3)],
+                         policy="affinity")
+    for r in reqs:
+        router.submit(r)
+    router.run(time_fn=_tick())
+    router.check_idle()
+    assert set(router.results) == {r.rid for r in reqs}
+    homes = {rid: next(k for k, eng in enumerate(router.engines)
+                       if rid in eng.results)
+             for rid in router.results}
+    a_home = {homes[rid] for rid in (0, 2, 4, 6)}
+    b_home = {homes[rid] for rid in (1, 3, 5, 7)}
+    assert len(a_home) == 1 and len(b_home) == 1, \
+        f"a session scattered across replicas: {homes}"
+    assert a_home != b_home, "cold sessions must balance, not pile up"
+    # the pinned replicas paid each prefix's prefill once — later
+    # session members hit the shard-local trie
+    for home in (a_home | b_home):
+        eng = router.engines[home]
+        assert eng.prefix_hit_tokens > 0
+
+
+@pytest.mark.slow
+def test_router_policies_all_complete(params, prompts, oracle):
+    """random and least-loaded scatter the session (no affinity), but
+    every stream is still bit-exact — placement never changes tokens."""
+    for policy in ("random", "least-loaded"):
+        router = FleetRouter([_engine(params) for _ in range(3)],
+                             policy=policy, seed=3)
+        for r in _requests(prompts):
+            router.submit(r)
+        router.run(time_fn=_tick())
+        router.check_idle()
+        assert set(router.results) == set(range(N_REQ))
+        for rid, toks in router.results.items():
+            n = len(np.asarray(toks))
+            assert np.array_equal(np.asarray(toks), oracle[rid, :n])
+
+
+# --- mid-stream failover -----------------------------------------------
+
+
+def test_kill_mid_stream_failover_bit_exact(params, prompts, oracle):
+    """Kill the replica holding every in-flight stream after 3 steps:
+    the requests replay from the prompt on survivors and the final
+    streams equal the row-keyed oracle bitwise; the emit cursor delivers
+    each token to the client exactly once (no duplicate, no tear)."""
+    delivered: dict[int, list[int]] = {}
+    router = FleetRouter([_engine(params) for _ in range(3)],
+                         policy="affinity",
+                         on_token=lambda rid, tok:
+                         delivered.setdefault(rid, []).append(tok))
+    reqs = _requests(prompts)
+    for r in reqs:
+        router.submit(r)
+    t = 0.0
+    for _ in range(3):
+        router.step(t)
+        t += 1.0
+    victim = router._where[0]  # the shared session's pinned replica
+    assert any(len(eng.running) for eng in router.engines), \
+        "trace drained before the kill — nothing in flight"
+    router.kill(victim)
+    assert router.replicas[victim].state == "quarantined"
+    assert router.failovers >= 1
+    while router._open:
+        router.step(t)
+        t += 1.0
+        router.self_check()
+    router.check_idle()
+    assert set(router.results) == {r.rid for r in reqs}, \
+        f"lost requests: failed={list(router.failed)}"
+    for rid, toks in router.results.items():
+        arr = np.asarray(toks)
+        assert np.array_equal(arr, oracle[rid, :len(arr)]), \
+            f"rid {rid}: failed-over stream diverged from the oracle"
+        # the client saw each token exactly once, in order
+        assert delivered[rid] == list(arr), \
+            f"rid {rid}: client stream duplicated or torn"
+    # the caller's original Request objects carry the full stream too
+    # (the benchmark reads these)
+    for r in reqs:
+        assert r.tokens == list(np.asarray(router.results[r.rid]))
+        assert len(r.emit_times) == len(r.tokens)
+
+
+def test_torn_stream_detected(params, prompts):
+    """A replayed token that diverges from the already-delivered prefix
+    is a torn stream — FleetInvariantViolation, never silent."""
+    router = FleetRouter([_engine(params) for _ in range(2)])
+    for r in _requests(prompts[:2]):
+        router.submit(r)
+    t = 0.0
+    while not router._delivered.get(0):
+        router.step(t)
+        t += 1.0
+    good = router._delivered[0][0]
+    with pytest.raises(FleetInvariantViolation, match="torn stream"):
+        router._seen[(0, 1)] = 0  # a fresh replay stream on replica 1
+        router._on_token(1, 0, good + 1)
+
+
+def test_watchdog_quarantines_hung_replica(params, prompts):
+    """A replica with running slots that stops producing events trips
+    the dispatch watchdog after ``watchdog_steps`` and its streams
+    complete on the survivor."""
+    router = FleetRouter([_engine(params) for _ in range(2)],
+                         policy="affinity", watchdog_steps=3)
+    for r in _requests(prompts):
+        router.submit(r)
+    t = 0.0
+    for _ in range(2):
+        router.step(t)
+        t += 1.0
+    victim = router._where[0]
+    assert router.engines[victim].running
+    router.replicas[victim].engine.step = lambda now=None: []
+    while router._open:
+        router.step(t)
+        t += 1.0
+    assert router.replicas[victim].state == "quarantined"
+    assert any(isinstance(e, ReplicaUnavailable)
+               and "watchdog" in str(e) for e in router.faults)
+    assert set(router.results) == set(range(N_REQ))
+
+
+def test_shed_storm_degrades_never_hangs(params, prompts):
+    """Zero survivors: every request fails with the retriable typed
+    error and run() returns — proportional degradation, not a cliff."""
+
+    def _boom(now=None):
+        raise RuntimeError("outage")
+
+    router = FleetRouter([_engine(params) for _ in range(2)])
+    for r in _requests(prompts):
+        router.submit(r)
+    for rep in router.replicas:
+        rep.engine.step = _boom
+    router.run(time_fn=_tick())  # must terminate
+    assert not router._open
+    assert all(rep.state == "quarantined" for rep in router.replicas)
+    assert set(router.failed) == set(range(N_REQ))
+    for err in router.failed.values():
+        assert isinstance(err, ReplicaUnavailable) and err.retriable
+    # and a fleet that is already fully down rejects at submit time
+    with pytest.raises(ReplicaUnavailable, match="no healthy replica"):
+        router.submit(Request(99, np.array(prompts[0]), 2, arrival=0.0))
+
+
+def test_duplicate_dispatch_caught_structurally(params, prompts):
+    """The same rid live on two replicas emits IDENTICAL tokens (same
+    key chain) — token-level checks cannot see it, the liveness sweep
+    must."""
+    router = FleetRouter([_engine(params) for _ in range(2)])
+    for r in _requests(prompts[:3]):
+        router.submit(r)
+    t = 0.0
+    for _ in range(2):
+        router.step(t)
+        t += 1.0
+    rid = next(iter(router._where))
+    other = 1 - router._where[rid]
+    router.engines[other].submit(
+        Request(rid, np.array(prompts[rid]), 2, arrival=0.0))
+    with pytest.raises(FleetInvariantViolation,
+                       match="live on two replicas"):
+        router.self_check()
+
+
+def test_router_validates_fleet_construction(params):
+    """Mismatched base keys would silently break failover bit-exactness
+    — rejected at construction, not discovered at the first kill."""
+    with pytest.raises(ValueError, match="base key"):
+        FleetRouter([_engine(params),
+                     _engine(params, key=jax.random.PRNGKey(9))])
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([])
+    with pytest.raises(ValueError, match="unknown policy"):
+        FleetRouter([_engine(params)], policy="round-robin")
+
+
+# --- servetrace fleet fold ---------------------------------------------
+
+
+def test_fold_fleet_additive_fields(params, prompts):
+    """fold() on a router emits the servetrace/v1 schema plus the
+    additive fleet section; per-request conservation holds across a
+    mid-trace kill, and the single-engine fold stays byte-compatible
+    (no fleet keys) so committed artifacts diff unchanged."""
+    router = FleetRouter([_engine(params) for _ in range(2)],
+                         policy="affinity")
+    for r in _requests(prompts):
+        router.submit(r)
+    t = 0.0
+    for _ in range(3):
+        router.step(t)
+        t += 1.0
+    router.kill(router._where[0])
+    while router._open:
+        router.step(t)
+        t += 1.0
+    art = servetrace.fold(router, family="serve_engine_prefix")
+    assert art["schema"] == servetrace.SCHEMA
+    assert art["fleet"]["replicas"] == 2
+    assert art["fleet"]["quarantines"] == 1
+    assert art["requests"]["failovers"] == router.failovers >= 1
+    assert art["requests"]["completed"] == N_REQ
+    assert art["conservation"]["ok"], art["conservation"]
+    states = art["fleet"]["states"]
+    assert states.count("quarantined") == 1
+    assert len(art["fleet"]["per_replica"]) == 2
+    # old single-engine artifacts: no fleet keys anywhere
+    solo = _engine(params)
+    for r in _requests(prompts):
+        solo.submit(r)
+    solo.run(time_fn=_tick())
+    art1 = servetrace.fold(solo, family="serve_engine_prefix")
+    assert "fleet" not in art1
+    assert "failovers" not in art1["requests"]
+    # fleet artifacts pass through the same CI diff gate
+    d = servetrace.diff_servetraces(art, art)
+    assert d["n_flagged"] == 0
+
+
+# --- the fleetsan matrix -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleetsan_single_fault_and_clean_smoke():
+    """Fleetsan verdict smoke: one absorbed fault (replica-crash →
+    quarantine + failover, bit-exact survivors) plus the clean
+    false-positive gate. Tier 2 with the full matrix — the harness
+    builds its own fleet/oracle shapes, and tier 1 already drives the
+    same failure paths through the router API directly (kill/watchdog/
+    torn-stream/duplicate tests above); the per-fault CI gate runs
+    every fault in scripts/run_tests_and_package.sh."""
+    row = fleet_chaos.run_fault("replica-crash", "none")
+    assert row["ok"], row
+    assert row["error"]["type"] == "ReplicaUnavailable"
+    clean = fleet_chaos.run_clean("none")
+    assert clean["ok"], clean
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh", ["none", "dp2"])
+def test_fleetsan_matrix_detects_every_fault(mesh):
+    """Every seeded fleet-level fault must surface its EXPECTED typed
+    error with bit-exact surviving streams, and the un-injected fleet
+    must drain with zero findings — identically on single-device and
+    dp2-per-replica meshes (the router is host-side control plane)."""
+    rows = [fleet_chaos.run_fault(name, mesh)
+            for name in fleet_chaos.fault_names()]
+    rows.append(fleet_chaos.run_clean(mesh))
+    bad = [(r["fault"], r.get("error")) for r in rows if not r["ok"]]
+    assert not bad, f"fleetsan verdicts failed on {mesh}: {bad}"
+    assert len(rows) == len(fleet_chaos.fault_names()) + 1 >= 8
+
+
+@pytest.mark.slow
+def test_fleetsan_cli_contract():
+    """--list enumerates ≥7 fault classes fast (no fleet build), a
+    single-fault run reports ok with exit 0, and an unknown fault is the
+    exit-2 build error, not a miss."""
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+    base = [sys.executable, "-m", "cs336_systems_tpu.serving.fleet_chaos"]
+
+    ls = subprocess.run(base + ["--list", "--json"], env=env,
+                        capture_output=True, text=True)
+    assert ls.returncode == 0
+    assert len(json.loads(ls.stdout)["faults"]) >= 7
+
+    one = subprocess.run(base + ["--fault", "shed-storm", "--json"],
+                         env=env, capture_output=True, text=True)
+    assert one.returncode == 0, one.stdout + one.stderr
+    row = json.loads(one.stdout)["rows"][0]
+    assert row["ok"] and row["error"]["type"] == "ReplicaUnavailable"
+    assert row["error"]["retriable"] is True
+
+    bad = subprocess.run(base + ["--fault", "no-such-fault", "--json"],
+                         env=env, capture_output=True, text=True)
+    assert bad.returncode == 2

@@ -32,9 +32,11 @@ from cs336_systems_tpu.serving import (
     DeadlineExceeded,
     DeadlinePolicy,
     FifoPolicy,
+    FleetInvariantViolation,
     InvariantViolation,
     PoolExhausted,
     RefcountViolation,
+    ReplicaUnavailable,
     Request,
     ServingEngine,
     ServingError,
@@ -101,6 +103,10 @@ class TestErrorTaxonomy:
         assert not CorruptBlockTable.retriable
         assert not AdmissionImpossible.retriable
         assert not InvariantViolation.retriable
+        # fleet layer (ISSUE 14): a replica failure invites re-dispatch;
+        # router-state corruption never does
+        assert ReplicaUnavailable.retriable
+        assert not FleetInvariantViolation.retriable
 
     def test_compat_bases(self):
         # pre-ISSUE-10 callers caught MemoryError / ValueError /
@@ -111,9 +117,15 @@ class TestErrorTaxonomy:
         assert issubclass(CorruptBlockTable, ValueError)
         assert issubclass(AdmissionImpossible, ValueError)
         assert issubclass(InvariantViolation, AssertionError)
+        # FleetInvariantViolation subclasses InvariantViolation so
+        # existing invariant handlers (and AssertionError sites) keep
+        # working one level up
+        assert issubclass(FleetInvariantViolation, InvariantViolation)
+        assert issubclass(FleetInvariantViolation, AssertionError)
         for cls in (PoolExhausted, DeadlineExceeded, SlotPoisoned,
                     RefcountViolation, CorruptBlockTable,
-                    AdmissionImpossible, InvariantViolation):
+                    AdmissionImpossible, InvariantViolation,
+                    ReplicaUnavailable, FleetInvariantViolation):
             assert issubclass(cls, ServingError)
 
     def test_shard_attribution(self):
@@ -123,6 +135,16 @@ class TestErrorTaxonomy:
         assert RefcountViolation("x").shard is None
         assert str(InvariantViolation("pool not conserved")) == \
             "pool not conserved"
+
+    def test_replica_attribution(self):
+        # ReplicaUnavailable carries the replica index in the typed
+        # surface AND the message; None = the whole fleet is down
+        e = ReplicaUnavailable("crashed mid-step", replica=2)
+        assert e.replica == 2 and e.retriable
+        assert str(e) == "replica 2: crashed mid-step"
+        down = ReplicaUnavailable("no healthy replica")
+        assert down.replica is None
+        assert str(down) == "no healthy replica"
 
 
 # --- exhaustive submit-time rejection ----------------------------------
