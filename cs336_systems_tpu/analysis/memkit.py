@@ -489,6 +489,8 @@ ARG_CLASSES: dict[str, tuple] = {
                      "batch", "batch", "batch"),
     "serve_engine_prefix": ("params", "kv-cache", "batch", "batch",
                             "batch", "batch", "batch", "batch"),
+    "serve_engine_chunked": ("params", "kv-cache", "batch", "batch",
+                             "batch", "batch", "batch", "batch"),
 }
 
 
@@ -506,6 +508,10 @@ ARG_CLASSES: dict[str, tuple] = {
 SERVE_KV_SPLIT: dict[str, tuple[int, int]] = {
     "serve_engine": (0, 3),
     "serve_engine_prefix": (1, 4),
+    # chunked steady state (same geometry as prefix): page 0 is the
+    # shared prefix page, the mid-chunk cursors' landed pages and the
+    # scratch page are private
+    "serve_engine_chunked": (1, 4),
 }
 
 

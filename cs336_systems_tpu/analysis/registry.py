@@ -416,6 +416,18 @@ def serve_engine_prefix_geometry():
     return 16, 3, 2, SERVE_PAGED_BLOCK
 
 
+def serve_engine_chunked_geometry():
+    """Registry geometry for the ``serve_engine_chunked`` family:
+    ``(slots, pages_per_shard, max_blocks, page_block)`` — the prefix
+    geometry verbatim. Chunked prefill (ISSUE 15) is host-side admission
+    state exactly like prefix reuse: the decode step program sees the
+    same slot batch and pool whether a slot joined monolithically or is
+    still mid-chunk (inactive, scratch-steered), so the family shares
+    the prefix geometry and differs only in its CONCRETE state shape
+    (half the slots mid-prefill)."""
+    return serve_engine_prefix_geometry()
+
+
 def serve_chaos_geometry():
     """Registry geometry for the servesan chaos harness
     (serving/chaos.py): ``(slots, n_pages, max_blocks, page_block)``.
@@ -457,6 +469,42 @@ def serve_engine_prefix_state(concrete: bool = False):
     row_off = jnp.arange(slots, dtype=jnp.int32)
     tables = jnp.tile(jnp.asarray([[0, 1], [0, 2]], jnp.int32),
                       (slots // 2, 1))
+    return logits, keys, pos, active, row_off, tables
+
+
+def serve_engine_chunked_state(concrete: bool = False):
+    """The serve_engine_chunked step's argument bundle — same layout and
+    geometry as ``serve_engine_prefix_state`` but with EVERY ODD SLOT
+    mid-chunked-prefill: active=0 (the decode step steers its writes to
+    the scratch page and its pool gather to the landed pages), pos at
+    the chunk cursor (one full block landed), table ``[0, 0]`` (the
+    write-block entry padded with the last landed page — exactly how
+    ``_drain_prefill`` parks a cursor's slot). Even slots are the prefix
+    state's mid-generation rows verbatim. This is the interleaved
+    steady state the chunked engine actually runs: decode emits for the
+    running half while the other half's prefill is still landing."""
+    slots, _, max_blocks, blk = serve_engine_chunked_geometry()
+    cfg = _tiny_cfg()
+    shapes = (
+        ((slots, cfg.vocab_size), jnp.float32),
+        ((slots, 2), jnp.uint32),
+        ((slots,), jnp.int32),
+        ((slots,), jnp.int32),
+        ((slots,), jnp.int32),
+        ((slots, max_blocks), jnp.int32),
+    )
+    if not concrete:
+        return tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes)
+    logits = jnp.zeros(shapes[0][0], jnp.float32)
+    keys = jnp.tile(jax.random.PRNGKey(7)[None, :], (slots, 1))
+    odd = (jnp.arange(slots, dtype=jnp.int32) % 2).astype(bool)
+    pos = jnp.where(odd, blk, blk + 2).astype(jnp.int32)
+    active = jnp.where(odd, 0, 1).astype(jnp.int32)
+    row_off = jnp.arange(slots, dtype=jnp.int32)
+    tables = jnp.where(odd[:, None],
+                       jnp.asarray([[0, 0]], jnp.int32),
+                       jnp.tile(jnp.asarray([[0, 1], [0, 2]], jnp.int32),
+                                (slots // 2, 1)))
     return logits, keys, pos, active, row_off, tables
 
 
@@ -511,6 +559,32 @@ def _build_serve_engine_prefix() -> Traced:
     return Traced(jaxpr, None, contract)
 
 
+def _build_serve_engine_chunked() -> Traced:
+    """The engine step at the CHUNKED-PREFILL steady state (half the
+    slots mid-chunk, half decoding). Chunk drains are separate host-side
+    dispatches through the bucketed suffix-prefill programs — the decode
+    step program is byte-identical to serve_engine's whether chunking is
+    on or off — so the lint contract is the decode-only contract
+    VERBATIM: chunked prefill must add ZERO collectives to the decode
+    step, and any drift here means the step started branching on which
+    slots are mid-prefill."""
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import lint_contract
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    _, pages, _, blk = serve_engine_chunked_geometry()
+    step = make_engine_step(cfg, blk, mesh=make_mesh({"dp": 8}),
+                            dp_axis="dp", temperature=0.9, top_k=8,
+                            donate=False)
+    pool = _engine_pool_abstract(pages)
+    jaxpr = jax.make_jaxpr(step)(_abstract_params(cfg), pool,
+                                 *serve_engine_chunked_state())
+    contract = dict(lint_contract(cfg, dp_axis="dp", decode_only=True),
+                    phase_scopes=SERVE_PHASE_SCOPES)
+    return Traced(jaxpr, None, contract)
+
+
 STEPS: tuple[StepSpec, ...] = (
     StepSpec("train_single", _build_train_single),
     StepSpec("train_single_bf16", _build_train_single_bf16),
@@ -539,6 +613,7 @@ STEPS: tuple[StepSpec, ...] = (
                                None, None, True, True)),
     StepSpec("serve_engine", _build_serve_engine),
     StepSpec("serve_engine_prefix", _build_serve_engine_prefix),
+    StepSpec("serve_engine_chunked", _build_serve_engine_chunked),
 )
 
 
@@ -566,6 +641,10 @@ HBM_BUDGET_BYTES: dict[str, int] = {
     # geometry (2 slots/shard over 3 pages + scratch): a budget trip here
     # but not on serve_engine means the larger slot batch, not the step,
     # grew — the kv split (mem_cli) says whether shared or private did
+    "serve_engine_chunked": 1 << 19,  # same program at the chunked
+    # steady state (half the slots mid-prefill): a trip here alone means
+    # the decode step started materializing per-cursor state it must
+    # never see — chunk drains are separate host-side dispatches
 }
 
 

@@ -61,9 +61,12 @@ HOST_PHASES = ("schedule_admit", "prefix_lookup", "table_rewrite")
 
 # engine families the CLI can replay — the names deliberately match
 # tracekit.FAMILIES so the device join reads the same step program
+# (serve_engine_chunked: the chunked-prefill engine, ISSUE 15 — same
+# decode step program, prefill drained in page-aligned chunks)
 ENGINE_FAMILIES: dict[str, dict] = {
     "serve_engine": {"shared_prefix": 0},
     "serve_engine_prefix": {"shared_prefix": 16},
+    "serve_engine_chunked": {"shared_prefix": 16, "prefill_chunk": 8},
 }
 
 _RESIDUAL_TOL = 1e-6  # seconds; clock reads are monotone, ties allowed
@@ -136,6 +139,53 @@ def decompose(engine) -> tuple[dict[int, dict], int]:
     return out, skipped
 
 
+def _check_chunk_conservation(fr) -> dict | None:
+    """Fold-time conservation of the chunked-prefill records (ISSUE 15):
+    every prefill span's ``tokens`` must equal the sum of its per-chunk
+    records, and for every rid that reached ``running`` via chunk
+    drains the summed chunk tokens must equal the ADMITTED suffix
+    tokens (the admit event's ``suffix_tokens``) — a rid still
+    mid-prefill or cancelled mid-prefill may only be at-or-under.
+    Returns None when the log carries no chunk records (unchunked
+    engines fold byte-identically to pre-ISSUE-15 artifacts), else
+    ``{"rids_checked": n, "ok": True}``; a violation raises — a torn
+    flight log must never fold silently."""
+    chunk_tok: dict = {}
+    for p in fr.prefills:
+        chunks = p.get("chunks")
+        if not chunks:
+            continue
+        assert p["tokens"] == sum(c["tokens"] for c in chunks), (
+            f"prefill span tokens {p['tokens']} != its chunk records "
+            f"{chunks}")
+        for c in chunks:
+            chunk_tok[c["rid"]] = chunk_tok.get(c["rid"], 0) + c["tokens"]
+    if not chunk_tok:
+        return None
+    suffix: dict = {}
+    running = set()
+    for e in fr.events:
+        if e["kind"] == "admit":
+            suffix.setdefault(e["rid"], e.get("suffix_tokens"))
+        elif e["kind"] == "running":
+            running.add(e["rid"])
+    checked = 0
+    for rid, tot in chunk_tok.items():
+        exp = suffix.get(rid)
+        if exp is None:
+            continue
+        if rid in running:
+            assert tot == exp, (
+                f"rid {rid}: chunk tokens {tot} != admitted suffix "
+                f"tokens {exp} — torn chunk records")
+            checked += 1
+        else:
+            assert tot <= exp, (
+                f"rid {rid}: chunk tokens {tot} exceed admitted suffix "
+                f"tokens {exp}")
+    return {"rids_checked": checked, "ok": True}
+
+
 def _windows(steps: list[dict], n: int) -> list[dict]:
     recs = [s for s in steps if s.get("counters")]
     if not recs:
@@ -201,6 +251,7 @@ def fold(engine, *, family: str | None = None,
     terminal = sum(e.get("tokens", 0) for e in fr.events
                    if e["kind"] in ("finish", "cancel", "poison"))
     live = sum(len(r.tokens) for r in engine.running.values())
+    chunk_cons = _check_chunk_conservation(fr)
 
     kinds = [e["kind"] for e in fr.events]
     return {
@@ -248,6 +299,11 @@ def fold(engine, *, family: str | None = None,
             "terminal_tokens": terminal,
             "live_tokens": live,
             "ok": emitted == terminal + live,
+            # additive (ISSUE 15): present only when the log carries
+            # per-chunk prefill records, so unchunked artifacts stay
+            # byte-identical to pre-chunking folds
+            **({"prefill_chunks": chunk_cons}
+               if chunk_cons is not None else {}),
         },
         "nonfinite_spans": fr.nonfinite_spans,
     }
@@ -427,7 +483,8 @@ def replay(family: str, *, requests: int = 12, load_rps: float = 25.0,
         params, cfg, key=jax.random.PRNGKey(0), slots=8, n_pages=8,
         max_blocks=4, page_block=8, temperature=0.9, top_k=8,
         mesh=mesh, dp_axis="dp",
-        clock=lambda: time.monotonic() - t0)
+        clock=lambda: time.monotonic() - t0,
+        prefill_chunk=spec.get("prefill_chunk"))
     for r in reqs:
         engine.submit(r)
     engine.run()
@@ -445,7 +502,9 @@ def replay(family: str, *, requests: int = 12, load_rps: float = 25.0,
                meta={"requests": requests, "load_rps": load_rps,
                      "seed": seed, "prompt_len": prompt_len,
                      "new_tokens": new_tokens,
-                     "shared_prefix": spec["shared_prefix"]})
+                     "shared_prefix": spec["shared_prefix"],
+                     **({"prefill_chunk": spec["prefill_chunk"]}
+                        if "prefill_chunk" in spec else {})})
     if join_err is not None:
         art["steps"]["device_join_error"] = join_err
     return art

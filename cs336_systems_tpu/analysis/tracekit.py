@@ -709,6 +709,47 @@ def _build_serve_engine_prefix() -> Runner:
                   mesh.size)
 
 
+def _build_serve_engine_chunked() -> Runner:
+    """The engine step at the CHUNKED-PREFILL registry geometry
+    (ISSUE 15): every odd slot mid-chunk (inactive, scratch-steered),
+    every even slot decoding against the shared prefix page. Same step
+    program as serve_engine; what this family pins is the TIMING of the
+    interleaved steady state — the decode dispatch the chunked engine
+    pays while half its slots are still landing prefill chunks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from cs336_systems_tpu.analysis.registry import (
+        _tiny_cfg, serve_engine_chunked_geometry, serve_engine_chunked_state)
+    from cs336_systems_tpu.models.transformer import init_transformer_lm
+    from cs336_systems_tpu.parallel.mesh import make_mesh
+    from cs336_systems_tpu.parallel.serve import engine_specs
+    from cs336_systems_tpu.serving.engine import make_engine_step
+
+    cfg = _tiny_cfg()
+    mesh = make_mesh({"dp": 8})
+    slots, pages, _, blk = serve_engine_chunked_geometry()
+    step = make_engine_step(cfg, blk, mesh=mesh, dp_axis="dp",
+                            temperature=0.9, top_k=8, donate=False)
+    params = init_transformer_lm(jax.random.PRNGKey(0), cfg)
+    _, pool_spec, _ = engine_specs(cfg, "dp", None)
+    sh = NamedSharding(mesh, pool_spec)
+    pool = tuple(jax.device_put(
+        jnp.zeros((mesh.size * (pages + 1), cfg.num_heads, blk,
+                   2 * cfg.d_head), cfg.cdtype), sh)
+        for _ in range(cfg.num_layers))
+    state = serve_engine_chunked_state(concrete=True)
+    # even slots attend their 10 consumed tokens + the new one; odd slots
+    # are masked rows (active=0) — count them at 1 so the MFU denominator
+    # matches the one lane of dummy work the masked row still runs
+    lens = np.where(np.arange(slots) % 2 == 1, 1, blk + 3).astype(np.int64)
+    flops = decode_flops_per_token(cfg, attend_lens=lens)
+    return Runner(step, (params, pool) + tuple(state), slots, flops,
+                  mesh.size)
+
+
 FAMILIES: dict[str, Callable[[], Runner]] = {
     "train_single": _build_train_single,
     "train_single_bf16": _build_train_single_bf16,
@@ -729,6 +770,7 @@ FAMILIES: dict[str, Callable[[], Runner]] = {
                                                True, True),
     "serve_engine": _build_serve_engine,
     "serve_engine_prefix": _build_serve_engine_prefix,
+    "serve_engine_chunked": _build_serve_engine_chunked,
 }
 
 

@@ -55,6 +55,21 @@ dumps each cell's full servetrace/v1 artifact for
 ``math.inf`` stamp on cancel/evict paths) are dropped before every
 percentile.
 
+``--prefill-chunk N`` (ISSUE 15) turns on chunked prefill: the engine
+splits every admitted suffix into page-aligned N-token chunks drained
+INTO the decode loop (at most ``--prefill-budget`` tokens of chunk work
+per step, default N), so a long prompt never stalls the running slots'
+decode for its whole prefill at once. Each cell runs TWICE on
+identically-seeded arrivals — chunked and the monolithic-join baseline
+— and the chunked row gains ``prefill_chunks`` /
+``max_step_prefill_tokens`` (engine telemetry: the budget bound, which
+must never exceed the budget) plus the twin's
+``unchunked_prefill_stall_p99_ms`` / ``unchunked_goodput_tok_s`` /
+``unchunked_completed`` columns. Streams are bit-identical either way
+(tests/test_chunked_prefill.py pins it); under the spike profile the
+chunked ``prefill_stall_p99_ms`` must drop at equal goodput — the CI
+gate (scripts/run_tests_and_package.sh).
+
 ``--replicas N`` (ISSUE 14) runs each cell against a ``FleetRouter``
 over N engine replicas instead of one engine — ``--router
 affinity|random|least-loaded`` emits one TWIN CELL per policy on
@@ -260,6 +275,16 @@ def run_cell(engine: ServingEngine, requests: list[Request],
         "decode_p99_ms": _p99("decode"),
         "host_overhead_pct": art["steps"]["host_overhead_pct"],
     }
+    # chunked-prefill columns (ISSUE 15): how many chunk dispatches the
+    # trace drained and the worst per-step prefill token count — the
+    # budget bound the CI gate asserts from this telemetry
+    engines = engine.engines if hasattr(engine, "replicas") else [engine]
+    if any(getattr(e, "prefill_chunk", None) is not None for e in engines):
+        row.update({
+            "prefill_chunks": sum(e.prefill_chunks for e in engines),
+            "max_step_prefill_tokens": max(e.max_step_prefill_tokens
+                                           for e in engines),
+        })
     if hasattr(engine, "replicas"):
         # fleet columns (ISSUE 14): routing/health outcome of the cell
         row.update({
@@ -282,7 +307,9 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
           servetrace_path: str | None = None,
           replicas: int = 0,
           router_policies: list[str] | None = None,
-          kill_at: int = 0) -> list[dict]:
+          kill_at: int = 0,
+          prefill_chunk: int = 0,
+          prefill_budget: int = 0) -> list[dict]:
     params = init_transformer_lm(jax.random.PRNGKey(seed), cfg)
     mesh = dp_axis = None
     if dp:
@@ -290,29 +317,31 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
 
         mesh, dp_axis = make_mesh({"dp": dp}), "dp"
 
-    def make_one(policy=None, clock=None):
+    def make_one(policy=None, clock=None, chunk=0):
         return ServingEngine(
             params, cfg, key=jax.random.PRNGKey(0), slots=slots,
             n_pages=n_pages, max_blocks=max_blocks,
             page_block=page_block, temperature=0.9, top_k=8,
             mesh=mesh, dp_axis=dp_axis, prefix_cache=prefix_cache,
-            policy=policy, clock=clock)
+            policy=policy, clock=clock,
+            prefill_chunk=chunk or None,
+            prefill_budget=(prefill_budget or None) if chunk else None)
 
-    def make_engine(policy_factory=None):
+    def make_engine(policy_factory=None, chunk=0):
         t0 = time.monotonic()
         # fresh engine per run: the trace starts at clock 0 with a cold
-        # pool, so cells (and the deadline A/B twins) are independent
-        # and replayable
+        # pool, so cells (and the deadline/chunked A/B twins) are
+        # independent and replayable
         return make_one(policy=policy_factory() if policy_factory else None,
-                        clock=lambda: time.monotonic() - t0)
+                        clock=lambda: time.monotonic() - t0, chunk=chunk)
 
-    def make_fleet(router_policy, policy_factory=None):
+    def make_fleet(router_policy, policy_factory=None, chunk=0):
         # N replicas sharing one trace clock and ONE base key — the
         # failover bit-exactness precondition the router checks
         t0 = time.monotonic()
         engines = [
             make_one(policy=policy_factory() if policy_factory else None,
-                     clock=lambda: time.monotonic() - t0)
+                     clock=lambda: time.monotonic() - t0, chunk=chunk)
             for _ in range(replicas)]
         router = FleetRouter(engines, policy=router_policy, seed=seed)
         if kill_at > 0:
@@ -343,6 +372,9 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
                        "requests": n_requests, "slots": slots,
                        "n_pages": n_pages, "slo_ms": slo_ms,
                        "shared_prefix": shared_prefix, "seed": seed}
+                if prefill_chunk > 0:
+                    row["prefill_chunk"] = prefill_chunk
+                    row["prefill_budget"] = prefill_budget or prefill_chunk
                 if rpol is not None:
                     row.update({"replicas": replicas,
                                 "router_policy": rpol,
@@ -355,19 +387,39 @@ def sweep(cfg: TransformerConfig, loads, profiles, n_requests: int,
                     st_path = f"{stem}.{row['name']}{ext or '.json'}"
 
                 def build():
-                    return (make_engine() if rpol is None
-                            else make_fleet(rpol))
+                    return (make_engine(chunk=prefill_chunk)
+                            if rpol is None
+                            else make_fleet(rpol, chunk=prefill_chunk))
 
                 row.update(run_cell(build(), make_requests(), slo_ms,
                                     servetrace_path=st_path))
+                if prefill_chunk > 0:
+                    # the chunked-prefill A/B twin (ISSUE 15): identical
+                    # seeded arrivals with monolithic joins — the
+                    # prefill-stall baseline the chunked row must beat
+                    # under the spike profile at equal goodput
+                    twin_eng = (make_engine(chunk=0) if rpol is None
+                                else make_fleet(rpol, chunk=0))
+                    twin = run_cell(twin_eng, make_requests(), slo_ms)
+                    row.update({
+                        "unchunked_prefill_stall_p99_ms":
+                            twin["prefill_stall_p99_ms"],
+                        "unchunked_goodput_tok_s":
+                            twin["goodput_tok_s"],
+                        "unchunked_completed": twin["completed"],
+                        "unchunked_ttft_p99_ms": twin["ttft_p99_ms"],
+                    })
                 if deadline_ms > 0:
                     # the admission-control A/B twin: identical seeded
                     # arrivals, DeadlinePolicy instead of strict FIFO —
                     # queue-expired requests shed with the retriable
                     # typed DeadlineExceeded instead of being served late
                     fifo_goodput = row.pop("deadline_goodput_tok_s")
-                    twin_eng = (make_engine(DeadlinePolicy) if rpol is None
-                                else make_fleet(rpol, DeadlinePolicy))
+                    twin_eng = (make_engine(DeadlinePolicy,
+                                            chunk=prefill_chunk)
+                                if rpol is None
+                                else make_fleet(rpol, DeadlinePolicy,
+                                                chunk=prefill_chunk))
                     twin = run_cell(twin_eng, make_requests(), slo_ms)
                     row.update({
                         "deadline_ms": deadline_ms,
@@ -428,6 +480,16 @@ def main() -> None:
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the engine's prefix cache (the unshared "
                         "A/B baseline)")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill: split every admitted suffix "
+                        "into page-aligned N-token chunks drained into "
+                        "the decode loop, and run each cell twice — "
+                        "chunked vs the monolithic-join baseline on "
+                        "identically-seeded arrivals (0 = off)")
+    p.add_argument("--prefill-budget", type=int, default=0,
+                   help="with --prefill-chunk: max prefill tokens "
+                        "drained per engine step across all mid-chunk "
+                        "requests (0 = the chunk size)")
     p.add_argument("--replicas", type=int, default=0,
                    help="run each cell against a FleetRouter over N "
                         "engine replicas instead of one engine "
@@ -503,7 +565,9 @@ def main() -> None:
                  deadline_ms=args.deadline_ms,
                  servetrace_path=args.servetrace,
                  replicas=args.replicas, router_policies=args.router,
-                 kill_at=args.kill_replica_at)
+                 kill_at=args.kill_replica_at,
+                 prefill_chunk=args.prefill_chunk,
+                 prefill_budget=args.prefill_budget)
     print_table(results_table(rows, latex_path=args.latex))
 
 

@@ -869,6 +869,39 @@ def prefill_suffix(params, suffix_ids, cfg: TransformerConfig, suffix_lens,
     return logits, tuple(pages), plens + slens
 
 
+def prefill_chunk(params, chunk_ids, cfg: TransformerConfig, chunk_lens,
+                  done_lens, page_tables, kv_pool, page_block: int,
+                  page_geom, reduce_axis: str | None = None):
+    """Prefill the NEXT chunk of each row's prompt against everything
+    already landed in the pool (chunked prefill, ISSUE 15).
+
+    A chunk IS a suffix prefill whose "prefix" is the portion of the
+    prompt that has already landed — prefix-cache hit pages plus every
+    earlier chunk's private pages, in block order. ``prefill_suffix``'s
+    offset machinery is exactly this computation (the first chunk of a
+    cache-miss prompt is a suffix prefill at offset 0), so this is a
+    documented delegation, not a new program: ``chunk_ids`` [B, CW] are
+    the next ``chunk_lens`` prompt tokens, ``done_lens`` [B] the
+    absolute token counts already landed (each a MULTIPLE of
+    ``page_block`` — the engine only dispatches block-aligned chunk
+    boundaries; only a prompt's FINAL chunk may be ragged, and then the
+    row leaves the chunk path), and ``page_tables`` [B, PNB] the landed
+    pages covering ``done_lens`` blocks. Returns ``prefill_suffix``'s
+    triple: the chunk's boundary logits (the final chunk's row is the
+    join logits ``slot_prefill`` would have produced), the chunk's page
+    contents laid out by ``page_geom``, and the advanced positions.
+
+    Bit-exactness is inherited, not re-argued: the gathered landed keys
+    equal the full prefill's post-rope K‖V at those positions, masked
+    pads contribute exact zeros, and the pinned ``optimization_barrier``
+    boundaries make each chunk compute from materialized inputs — so
+    chunking changes WHEN prefill compute runs, never its result
+    (tests/test_chunked_prefill.py pins the engine-level stream)."""
+    return prefill_suffix(
+        params, chunk_ids, cfg, chunk_lens, done_lens, page_tables,
+        kv_pool, page_block, page_geom, reduce_axis=reduce_axis)
+
+
 def unstack_blocks(params):
     """Stacked [L, ...]-leaf block params → a tuple of per-layer pytrees.
 

@@ -87,9 +87,13 @@ def _geometry():
     return serve_chaos_geometry()
 
 
-def _build_engine(mesh_name: str = "none", seed: int = 0):
+def _build_engine(mesh_name: str = "none", seed: int = 0,
+                  prefill_chunk: int | None = None):
     """The standard chaos engine: registry geometry, tiny config, prefix
-    cache on, virtual clock (the harness passes explicit ``now``)."""
+    cache on, virtual clock (the harness passes explicit ``now``).
+    ``prefill_chunk``: build the CHUNKED-prefill engine (ISSUE 15) —
+    the chunked fault classes need mid-prefill cursors to corrupt, and
+    one chunk per step keeps cursors live well past PRE_STEPS."""
     import jax
 
     from cs336_systems_tpu.analysis.registry import _tiny_cfg
@@ -111,7 +115,7 @@ def _build_engine(mesh_name: str = "none", seed: int = 0):
     return ServingEngine(
         params, cfg, key=jax.random.PRNGKey(seed + 1), slots=slots,
         n_pages=n_pages, max_blocks=max_blocks, page_block=blk,
-        mesh=mesh, dp_axis=dp, tp_axis=tp)
+        mesh=mesh, dp_axis=dp, tp_axis=tp, prefill_chunk=prefill_chunk)
 
 
 def _build_requests(seed: int):
@@ -255,6 +259,36 @@ def _inject_premature_evict(eng):
     eng.pools[slot // eng.slots_per].free(req.rid)
 
 
+def _prefill_victim(eng):
+    """The lowest-slot mid-prefill cursor (the chunked faults' victim).
+    The chunked trace guarantees one exists at injection time: 8
+    two-chunk prompts drain at one chunk per step, so after PRE_STEPS=3
+    most cursors are still live on every mesh."""
+    if not eng.prefilling:
+        raise ChaosBuildError("no mid-prefill cursor to corrupt")
+    slot = min(eng.prefilling)
+    return slot, eng.prefilling[slot]
+
+
+def _inject_torn_chunk_state(eng):
+    """Tear a mid-prefill cursor: advance ``done`` off the page-block
+    grid (a lost/duplicated chunk-accounting bug). The next drain would
+    prefill the wrong tokens at the wrong offsets — the chunk-cursor
+    sweep in ``self_check`` must catch it before any dispatch."""
+    _slot, st = _prefill_victim(eng)
+    st.done += 3  # stays in [0, prompt) but off the block grid
+
+
+def _inject_leaked_chunk_pages(eng):
+    """Evict a mid-prefill request WITHOUT releasing its cursor — its
+    private pages keep their owner record but no running or mid-prefill
+    rid accounts for them (the mid-prefill-evict leak seam
+    ``_release_prefill`` exists to close). The pool-conservation/orphan
+    sweep must fire."""
+    slot, _st = _prefill_victim(eng)
+    del eng.prefilling[slot]  # cursor gone, pages never freed
+
+
 # fault -> (injector, expected error classes, message pattern)
 FAULTS = {
     "leak-page": (
@@ -277,7 +311,17 @@ FAULTS = {
         r"duplicate|double"),
     "premature-evict": (
         _inject_premature_evict, (InvariantViolation,), r"not allocated"),
+    "torn-chunk-state": (
+        _inject_torn_chunk_state, (InvariantViolation,),
+        r"torn chunk cursor"),
+    "leaked-chunk-pages": (
+        _inject_leaked_chunk_pages, (InvariantViolation, RefcountViolation),
+        r"non-running|disagree"),
 }
+
+# faults that need the CHUNKED engine (ISSUE 15): mid-prefill cursors
+# only exist when prefill_chunk is set — one page-block chunk per step
+CHUNKED_FAULTS = {"torn-chunk-state", "leaked-chunk-pages"}
 
 
 def fault_names():
@@ -303,7 +347,8 @@ def _drive(eng, inject=None):
     try:
         eng.self_check()
         for _ in range(MAX_STEPS):
-            if not eng.running and not len(eng.scheduler):
+            if (not eng.running and not eng.prefilling
+                    and not len(eng.scheduler)):
                 break
             eng.step(t)
             t += 1.0
@@ -325,7 +370,10 @@ def run_fault(name: str, mesh_name: str = "none", seed: int = 0) -> dict:
     if name not in FAULTS:
         raise ChaosBuildError(f"unknown fault {name!r} (see --list)")
     inject, expected, pattern = FAULTS[name]
-    eng = _build_engine(mesh_name, seed)
+    eng = _build_engine(
+        mesh_name, seed,
+        prefill_chunk=(_geometry()[3] if name in CHUNKED_FAULTS
+                       else None))
     for r in _build_requests(seed):
         eng.submit(r)
     try:

@@ -62,6 +62,26 @@ was built for):
   the SAME clock reads either way so stateful test clocks tick
   identically.
 
+- CHUNKED PREFILL (ISSUE 15, opt-in via ``prefill_chunk``): a joining
+  request's prompt no longer runs as one monolithic prefill that stalls
+  every active decode slot for the full prompt. ``_admit`` allocates the
+  request's pages up front (identical feasibility/blocking behavior)
+  but enqueues a ``_PrefillState`` cursor instead of dispatching;
+  each ``step()`` then drains at most ``prefill_budget`` tokens of
+  page-aligned chunk work (models/decode.prefill_chunk — a chunk IS a
+  suffix prefill whose prefix is everything already landed, so chunk
+  dispatches share ``_prefill_suffix_fn``'s compiled shape buckets:
+  zero extra steady-state compiles) before the decode dispatch. The
+  request becomes ``running`` only when its final chunk's boundary
+  logits land — exactly the state ``slot_prefill`` would have produced,
+  so streams stay BIT-IDENTICAL to the unchunked engine and the
+  row-keyed oracle (chunking changes WHEN prefill compute runs, never
+  its result). With the prefix cache on, only uncached-suffix tokens
+  are chunked and the completed prompt publishes exactly as before.
+  All host-side scheduling: the jit decode step program is
+  byte-identical chunking on/off (decode-only lint contract verbatim,
+  zero new collectives; lint family serve_engine_chunked pins it).
+
 - ROBUSTNESS (ISSUE 10): every failure is a typed ``serving.errors``
   exception with a ``retriable`` verdict; admission is policy-pluggable
   (``scheduler.DeadlinePolicy`` sheds SLO-unreachable requests with a
@@ -99,6 +119,7 @@ from cs336_systems_tpu.models.decode import (
     PAGE_BLOCK,
     _sample,
     decode_step,
+    prefill_chunk,
     prefill_suffix,
     slot_prefill,
     unstack_blocks,
@@ -186,6 +207,38 @@ def _pow2(n: int) -> int:
     return p
 
 
+# Measurement seam (scripts/check_chunked_prefill_gate.py): called with
+# the token count of every prefill dispatch BETWEEN the span's two clock
+# reads, so a deterministic work-proportional virtual clock can charge
+# prefill time per token — the flight-recorder stall decomposition then
+# compares chunked vs monolithic prefill on structure alone, no wall
+# jitter. Same idiom as checkpoint._FAULT_HOOK / train_cli._STEP_FAULT_
+# HOOK; None (a no-op) in production.
+_PREFILL_CLOCK_HOOK = None
+
+
+class _PrefillState:
+    """Host-side cursor of one mid-prefill (chunked) request: the slot
+    it will occupy, the acquired prefix-hit pages, the private pages
+    for its uncached tail, and ``done`` — the absolute prompt-token
+    count already landed in the pool. ``done`` starts at hit·block,
+    advances one chunk per drained step, and is ALWAYS a multiple of
+    the page block while the cursor lives (only a prompt's final chunk
+    may be ragged, and landing it retires the cursor). The slot stays
+    INACTIVE (scratch-steered in the decode step) until the final
+    chunk's boundary logits move the request to ``running``."""
+
+    __slots__ = ("slot", "req", "priv", "hit", "hit_pages", "done",
+                 "chunks")
+
+    def __init__(self, slot, req, priv, hit, hit_pages, done):
+        self.slot, self.req = slot, req
+        self.priv, self.hit = list(priv), hit
+        self.hit_pages = list(hit_pages)
+        self.done = done   # absolute tokens landed (hit·block at admit)
+        self.chunks = 0    # chunks dispatched so far
+
+
 class ServingEngine:
     """Continuous-batching serving: submit ``Request``s, step the slot
     batch, stream tokens back per request.
@@ -214,11 +267,31 @@ class ServingEngine:
                  tp_axis: str | None = None,
                  clock=None, on_token=None, prefix_cache: bool = True,
                  policy: AdmissionPolicy | None = None,
-                 flight: bool = True):
+                 flight: bool = True,
+                 prefill_chunk: int | None = None,
+                 prefill_budget: int | None = None):
         if page_block <= 0 or page_block % 8:
             raise ValueError(
                 f"page block must be a positive multiple of 8, "
                 f"got {page_block}")
+        # chunked prefill (ISSUE 15): chunk = per-request tokens per
+        # drained step (page-aligned so every non-final chunk boundary
+        # lands on a block edge); budget = the per-STEP token bound
+        # across all mid-prefill requests (defaults to one chunk).
+        # None = the unchunked engine, byte-identical to pre-ISSUE-15.
+        if prefill_chunk is not None:
+            if prefill_chunk <= 0 or prefill_chunk % page_block:
+                raise ValueError(
+                    f"prefill_chunk must be a positive multiple of "
+                    f"page_block={page_block}, got {prefill_chunk}")
+            if prefill_budget is None:
+                prefill_budget = prefill_chunk
+            elif prefill_budget < prefill_chunk:
+                raise ValueError(
+                    f"prefill_budget ({prefill_budget}) must be >= "
+                    f"prefill_chunk ({prefill_chunk})")
+        elif prefill_budget is not None:
+            raise ValueError("prefill_budget requires prefill_chunk")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if n_pages < 1 or max_blocks < 1:
@@ -280,6 +353,14 @@ class ServingEngine:
         self.failed: dict[int, ServingError] = {}
         self.cancelled: dict[int, np.ndarray] = {}
         self.steps = 0
+        # chunked prefill: mid-prefill cursors by slot — dict insertion
+        # order IS the FIFO drain order — plus the two benchmark
+        # telemetry counters (benchmarks/serving.py columns)
+        self.prefill_chunk = prefill_chunk
+        self.prefill_budget = prefill_budget
+        self.prefilling: dict[int, _PrefillState] = {}
+        self.prefill_chunks = 0           # chunk dispatches, total
+        self.max_step_prefill_tokens = 0  # max tokens drained per step
 
         # host-side slot state, re-uploaded per step (see module note)
         self.tables = np.zeros((slots, max_blocks), np.int32)
@@ -342,7 +423,9 @@ class ServingEngine:
                 f"request {req.rid} needs {npg} blocks; tables are "
                 f"{self.max_blocks} wide")
         if (req.rid in self.scheduler
-                or any(r.rid == req.rid for r in self.running.values())):
+                or any(r.rid == req.rid for r in self.running.values())
+                or any(st.req.rid == req.rid
+                       for st in self.prefilling.values())):
             raise AdmissionImpossible(
                 f"request {req.rid} is already queued or running "
                 f"(duplicate rid)")
@@ -371,7 +454,16 @@ class ServingEngine:
         published by joins already collected in THIS batch, the batch is
         FLUSHED first (prefill + publish) and admission continues — an
         arrival burst sharing a cold prefix prefills it once, not N
-        times."""
+        times.
+
+        CHUNKED mode (``prefill_chunk`` set): slot/page selection,
+        feasibility and blocking are IDENTICAL, but instead of a join
+        the request gets a ``_PrefillState`` cursor — ``_drain_prefill``
+        runs its chunks across subsequent steps and only the final
+        chunk makes it ``running``. A cold shared prefix may prefill
+        more than once (no pending-flush — the cursor batch spans
+        steps), which publish-skips-cached-blocks makes harmless:
+        streams are row-local either way."""
         # policy shedding first: an expired request must never reach a
         # slot (FIFO's policy sheds nothing — identical behavior)
         for req, err in self.scheduler.shed_expired(now):
@@ -392,7 +484,8 @@ class ServingEngine:
             free_slot = {}
             for s in range(self.slots):
                 k = s // self.slots_per
-                if s not in self.running and k not in free_slot:
+                if (s not in self.running and s not in self.prefilling
+                        and k not in free_slot):
                     free_slot[k] = s
             if self.prefix_caches is None:
                 slot = None
@@ -405,14 +498,20 @@ class ServingEngine:
                 self.scheduler.pop(req.rid)
                 pages = self.pools[slot // self.slots_per].alloc(
                     npg, req.rid)
-                self.running[slot] = req
                 self.prefill_tokens += req.prompt.size
-                joins.append((slot, req, pages, 0, []))
                 admitted += 1
                 self.flight.event(
                     "admit", req.rid, self._t(now), slot=slot,
                     shard=slot // self.slots_per, hit_tokens=0,
                     suffix_tokens=int(req.prompt.size))
+                if self.prefill_chunk is not None:
+                    # chunked: enqueue a cursor instead of a join — the
+                    # request runs only when its last chunk lands
+                    self.prefilling[slot] = _PrefillState(
+                        slot, req, pages, 0, [], 0)
+                else:
+                    self.running[slot] = req
+                    joins.append((slot, req, pages, 0, []))
                 continue
 
             t_lk = self._t(now)
@@ -455,7 +554,6 @@ class ServingEngine:
             if need > pool.available:
                 cache.spill(need - pool.available)
             priv = pool.alloc(need, req.rid)
-            self.running[slot] = req
             req.prefix_hit_tokens = hit * self.page_block
             self.prefix_hit_tokens += hit * self.page_block
             self.prefix_prompt_tokens += req.prompt.size
@@ -468,6 +566,8 @@ class ServingEngine:
             if cached_logits is not None:
                 # zero-prefill join: the whole prompt is cached and the
                 # publisher's boundary logits replay the join state
+                # (chunked mode too — there is nothing to chunk)
+                self.running[slot] = req
                 t_rw = self._t(now)
                 self.logits[slot] = cached_logits
                 self.pos[slot] = req.prompt.size
@@ -485,6 +585,12 @@ class ServingEngine:
                                   step=self.steps)
                 continue
             self.prefill_tokens += req.prompt.size - hit * self.page_block
+            if self.prefill_chunk is not None:
+                self.prefilling[slot] = _PrefillState(
+                    slot, req, priv, hit, hit_pages,
+                    hit * self.page_block)
+                continue
+            self.running[slot] = req
             pending[shard].update(hashes[hit:])
             joins.append((slot, req, priv, hit, hit_pages))
         if joins:
@@ -541,6 +647,42 @@ class ServingEngine:
                   dest):
             logits, pages, _ = prefill_suffix(
                 params, ids, cfg, slens, plens, ptab, pool, blk,
+                (None, prows, pblks), reduce_axis=tp)
+            pool = tuple(x.at[dest].set(pg) for x, pg in zip(pool, pages))
+            return logits, pool
+
+        if self.mesh is None:
+            fn = jax.jit(local, donate_argnums=(1,))
+        else:
+            pspecs, pool_spec, batch_spec = engine_specs(
+                cfg, self.dp_axis, tp)
+            fn = jax.jit(shard_map(
+                local, mesh=self.mesh,
+                in_specs=(pspecs, pool_spec, batch_spec, batch_spec,
+                          batch_spec, batch_spec, batch_spec, batch_spec,
+                          batch_spec),
+                out_specs=(batch_spec, pool_spec),
+                check_vma=False), donate_argnums=(1,))
+        self._pf_cache[cache_key] = fn
+        return fn
+
+    def _prefill_chunk_fn(self, jw: int, sw: int, npg: int, pnb: int):
+        """Compiled chunk-prefill bucket. ``decode.prefill_chunk`` IS
+        ``prefill_suffix`` (a documented delegation), so the bucket is
+        cached under the SAME key as ``_prefill_suffix_fn`` — chunk
+        dispatches and suffix joins of one shape share one compiled
+        program, and chunking adds zero steady-state compiles beyond
+        the suffix path's existing buckets."""
+        cache_key = ("sfx", jw, sw, npg, pnb)
+        fn = self._pf_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        cfg, blk, tp = self.cfg, self.page_block, self.tp_axis
+
+        def local(params, pool, ids, slens, dlens, ptab, prows, pblks,
+                  dest):
+            logits, pages, _ = prefill_chunk(
+                params, ids, cfg, slens, dlens, ptab, pool, blk,
                 (None, prows, pblks), reduce_axis=tp)
             pool = tuple(x.at[dest].set(pg) for x, pg in zip(pool, pages))
             return logits, pool
@@ -655,10 +797,12 @@ class ServingEngine:
         # the prefill span: operand build + bucket dispatch + logits
         # readback — the window during which every OTHER running slot's
         # decode is blocked (servetrace's prefill_stall component)
+        tokens = int(sum(j[1].prompt.size - j[3] * blk for j in joins))
+        if _PREFILL_CLOCK_HOOK is not None:
+            _PREFILL_CLOCK_HOOK(tokens)
         t_pf1 = self._t(now)
         self.flight.prefill(
-            t_pf0, t_pf1, [j[1].rid for j in joins],
-            tokens=int(sum(j[1].prompt.size - j[3] * blk for j in joins)))
+            t_pf0, t_pf1, [j[1].rid for j in joins], tokens=tokens)
         for k, v in enumerate(per_shard):
             for r, (slot, req, priv, hit, hit_pages) in enumerate(v):
                 self.logits[slot] = lg[k * jw + r]
@@ -683,6 +827,122 @@ class ServingEngine:
         self.flight.span("table_rewrite", t_pf1, self._t(now))
         for slot, req, priv, hit, hit_pages in joins:
             self.flight.event("running", req.rid, t_pf1, step=self.steps)
+
+    def _drain_prefill(self, now: float) -> None:
+        """Run at most ``prefill_budget`` tokens of chunk work — the
+        bounded per-step prefill bill chunking exists to enforce
+        (ISSUE 15; the flight-recorder prefill records are what the CI
+        gate asserts the bound from).
+
+        Drain policy: mid-prefill cursors in FIFO admission order, at
+        most ONE chunk of ``min(prefill_chunk, remaining)`` tokens
+        each, stopping at the first cursor whose chunk would push the
+        step total over the budget (strict FIFO — nothing behind it
+        bypasses; the first cursor always fits since chunk <= budget,
+        so every non-empty drain makes progress). The batch dispatches
+        exactly like a suffix join batch — each row's "prefix" is its
+        landed blocks (hit pages + earlier chunks' private pages) —
+        and a row whose cursor reaches the prompt end takes its
+        boundary logits as the join state ``slot_prefill`` would have
+        produced, publishes, and moves to ``running``."""
+        if not self.prefilling:
+            return
+        batch = []  # (cursor, chunk tokens) in FIFO admission order
+        total = 0
+        for st in self.prefilling.values():
+            ct = min(self.prefill_chunk, st.req.prompt.size - st.done)
+            if total + ct > self.prefill_budget:
+                break
+            batch.append((st, ct))
+            total += ct
+        if not batch:
+            return
+        t_pf0 = self._t(now)
+        blk, dp, npages = self.page_block, self.dp, self.n_pages
+        per_shard = [[] for _ in range(dp)]
+        for st, ct in batch:
+            per_shard[st.slot // self.slots_per].append((st, ct))
+        jw = _pow2(max(len(v) for v in per_shard))
+        sw = -(-max(ct for _, ct in batch) // 8) * 8
+        npg = _pow2(max(
+            max((sum(-(-ct // blk) for _, ct in v)
+                 for v in per_shard if v), default=1), 1))
+        pnb = _pow2(max(max(st.done // blk for st, _ in batch), 1))
+        ids = np.zeros((dp * jw, sw), np.int32)
+        slens = np.ones((dp * jw,), np.int32)  # dummy rows: 1 pad token
+        dlens = np.zeros((dp * jw,), np.int32)
+        # pad table entries read the scratch page; the validity mask
+        # retires them before they reach a softmax
+        ptab = np.full((dp * jw, pnb), npages, np.int32)
+        prows = np.zeros((dp * npg,), np.int32)
+        pblks = np.zeros((dp * npg,), np.int32)
+        dest = np.full((dp * npg,), npages, np.int32)  # default: scratch
+        for k, v in enumerate(per_shard):
+            o = 0
+            for r, (st, ct) in enumerate(v):
+                ids[k * jw + r, :ct] = st.req.prompt[st.done:st.done + ct]
+                slens[k * jw + r] = ct
+                dlens[k * jw + r] = st.done
+                nb_done = st.done // blk  # blocks already landed
+                landed = (st.hit_pages + st.priv)[:nb_done]
+                ptab[k * jw + r, :nb_done] = landed
+                nbc = -(-ct // blk)  # this chunk's blocks
+                prows[k * npg + o:k * npg + o + nbc] = r
+                pblks[k * npg + o:k * npg + o + nbc] = np.arange(nbc)
+                first = nb_done - st.hit  # first private-block index
+                dest[k * npg + o:k * npg + o + nbc] = \
+                    st.priv[first:first + nbc]
+                o += nbc
+        fn = self._prefill_chunk_fn(jw, sw, npg, pnb)
+        logits, self._pool = fn(
+            self.params, self._pool, jnp.asarray(ids),
+            jnp.asarray(slens), jnp.asarray(dlens), jnp.asarray(ptab),
+            jnp.asarray(prows), jnp.asarray(pblks), jnp.asarray(dest))
+        lg = np.asarray(jax.device_get(logits))
+        if _PREFILL_CLOCK_HOOK is not None:
+            _PREFILL_CLOCK_HOOK(int(total))
+        t_pf1 = self._t(now)
+        self.flight.prefill(
+            t_pf0, t_pf1, [st.req.rid for st, _ in batch],
+            tokens=int(total),
+            chunks=[{"rid": st.req.rid, "chunk": st.chunks,
+                     "tokens": int(ct)} for st, ct in batch])
+        self.prefill_chunks += len(batch)
+        self.max_step_prefill_tokens = max(
+            self.max_step_prefill_tokens, total)
+        finished = []
+        for k, v in enumerate(per_shard):
+            for r, (st, ct) in enumerate(v):
+                st.done += ct
+                st.chunks += 1
+                if st.done == st.req.prompt.size:
+                    finished.append((st, lg[k * jw + r]))
+        for st, boundary in finished:
+            slot, req = st.slot, st.req
+            self.logits[slot] = boundary
+            self.pos[slot] = req.prompt.size
+            self.active[slot] = 1
+            self.keys[slot] = self.base_key  # fresh per-slot chain
+            self.row_off[slot] = req.row
+            tab = st.hit_pages + st.priv
+            self.tables[slot] = tab + [tab[-1]] * (
+                self.max_blocks - len(tab))
+            del self.prefilling[slot]
+            self.running[slot] = req
+        if self.prefix_caches is not None and finished:
+            for st, _ in finished:
+                cache = self.prefix_caches[st.slot // self.slots_per]
+                nbp = -(-(st.req.prompt.size - st.hit * blk) // blk)
+                cache.publish(
+                    st.req.prompt, st.req.rid,
+                    {st.hit + j: st.priv[j] for j in range(nbp)},
+                    logits=self.logits[st.slot])
+            self._update_shared_peak()
+        self._validate_tables()
+        self.flight.span("table_rewrite", t_pf1, self._t(now))
+        for st, _ in finished:
+            self.flight.event("running", st.req.rid, t_pf1,
+                              step=self.steps)
 
     def _validate_tables(self) -> None:
         """The block-table contracts, per shard: no scratch id in any
@@ -715,6 +975,21 @@ class ServingEngine:
         self.active[slot] = 0
         del self.running[slot]
         req.finish_time = when
+
+    def _release_prefill(self, slot: int, st: _PrefillState,
+                         when: float) -> None:
+        """Mid-prefill eviction (cancel): free the cursor's private
+        pages, release its acquired prefix refs, drop the cursor. The
+        slot was never activated, so no device state needs touching —
+        the partially-landed KV is dead weight the pages' next owner
+        overwrites, and the pool conservation gate sees zero leaks."""
+        pool = self.pools[slot // self.slots_per]
+        if pool.owns(st.req.rid):
+            pool.free(st.req.rid)
+        if pool.acquired_by(st.req.rid):
+            pool.release(st.req.rid)
+        del self.prefilling[slot]
+        st.req.finish_time = when
 
     def _finish(self, slot: int, req: Request, when: float) -> None:
         self._release_slot(slot, req, when)
@@ -753,6 +1028,15 @@ class ServingEngine:
                 self.flight.event("cancel", rid, when, running=True,
                                   tokens=len(run.tokens))
                 return True
+        for slot, st in list(self.prefilling.items()):
+            if st.req.rid == rid:
+                # mid-prefill: no tokens streamed yet — the cursor's
+                # pages release cleanly, same as a queued cancel
+                self._release_prefill(slot, st, when)
+                self.cancelled[rid] = np.asarray(st.req.tokens, np.int32)
+                self.flight.event("cancel", rid, when, running=False,
+                                  tokens=0)
+                return True
         return False
 
     def _contain_poisoned(self, when: float) -> list:
@@ -787,6 +1071,9 @@ class ServingEngine:
         t_enter = self._t(now)
         self.flight.begin_step(step_i, t_enter)
         self._admit(now)
+        # chunked prefill: at most prefill_budget tokens of chunk work
+        # before the decode dispatch (no-op when prefill_chunk is None)
+        self._drain_prefill(now)
         # containment BEFORE dispatch: a poisoned carry never reaches
         # the sampler (joins above may have admitted poisoned prefills)
         self._contain_poisoned(now)
@@ -860,6 +1147,7 @@ class ServingEngine:
         servetrace artifact."""
         return {
             "running": len(self.running),
+            "prefilling": len(self.prefilling),
             "queued": len(self.scheduler),
             "arrived": self.scheduler.depth(now),
             "free_pages": sum(p.available for p in self.pools),
@@ -875,14 +1163,15 @@ class ServingEngine:
         fast-forwards an idle batch to the next arrival); without it the
         engine's ``clock`` (wall time) or "everything already arrived"
         (math.inf) applies."""
-        while len(self.scheduler) or self.running:
+        while len(self.scheduler) or self.running or self.prefilling:
             if time_fn is not None:
                 now = time_fn()
             elif self.clock is not None:
                 now = self.clock()
             else:
                 now = math.inf
-            if not self.running and self.scheduler.head(now) is None:
+            if (not self.running and not self.prefilling
+                    and self.scheduler.head(now) is None):
                 nxt = self.scheduler.next_arrival()
                 if self.clock is not None and time_fn is None:
                     _time.sleep(min(max(nxt - now, 0.0), 0.05))
@@ -901,6 +1190,12 @@ class ServingEngine:
         for k in range(self.dp):
             tabs = [self.tables[s] for s in sorted(self.running)
                     if s // self.slots_per == k]
+            # mid-prefill cursors hold pages with no live table yet —
+            # their page lists stand in as pseudo-tables so acquired
+            # hit pages' refcounts reconcile
+            tabs += [np.asarray(st.hit_pages + st.priv, np.int32)
+                     for s, st in sorted(self.prefilling.items())
+                     if s // self.slots_per == k]
             try:
                 self.pools[k].check_conserved(tabs)
             except ServingError as e:
@@ -914,6 +1209,10 @@ class ServingEngine:
             raise InvariantViolation(
                 f"requests still running: "
                 f"{sorted(r.rid for r in self.running.values())}")
+        if self.prefilling:
+            raise InvariantViolation(
+                f"requests still mid-prefill: "
+                f"{sorted(st.req.rid for st in self.prefilling.values())}")
         for k, p in enumerate(self.pools):
             if self.prefix_caches is not None:
                 self.prefix_caches[k].drop_unreferenced()
@@ -935,8 +1234,13 @@ class ServingEngine:
         3. prefix-trie ↔ pool consistency → ``InvariantViolation``
         4. slot ↔ allocator coherence: active mask == running set,
            every running slot's table pages allocated TO that rid,
-           every private owner a running rid → ``InvariantViolation``
-        5. finite carried sampling state → ``SlotPoisoned``
+           every private owner a running or mid-prefill rid →
+           ``InvariantViolation``
+        5. chunk-cursor coherence (chunked prefill, ISSUE 15): a
+           mid-prefill slot is inactive and not running, its cursor is
+           block-aligned inside [hit·block, prompt), and its pages are
+           allocated to it → ``InvariantViolation`` (torn chunk cursor)
+        6. finite carried sampling state → ``SlotPoisoned``
 
         Raises the first violation; a clean engine returns None. Pure
         host-side reads — never dispatches, safe at any point."""
@@ -946,6 +1250,7 @@ class ServingEngine:
             for k, cache in enumerate(self.prefix_caches):
                 cache.self_check(shard=k)
         all_rids = [req.rid for req in self.running.values()]
+        all_rids += [st.req.rid for st in self.prefilling.values()]
         running_rids = set(all_rids)
         if len(all_rids) != len(running_rids):
             dupes = sorted(r for r in running_rids
@@ -972,6 +1277,37 @@ class ServingEngine:
                 raise InvariantViolation(
                     f"slot {slot} (rid {req.rid}): table pages "
                     f"{sorted(stray)} are not allocated to it", shard=k)
+        for slot, st in sorted(self.prefilling.items()):
+            req, k = st.req, slot // self.slots_per
+            pool = self.pools[k]
+            if slot in self.running:
+                raise InvariantViolation(
+                    f"slot {slot}: both running and mid-prefill "
+                    f"(rid {req.rid})", shard=k)
+            if self.active[slot]:
+                raise InvariantViolation(
+                    f"slot {slot} (rid {req.rid}): active while "
+                    f"mid-prefill — a chunked join may only activate "
+                    f"on its final chunk", shard=k)
+            lo = st.hit * self.page_block
+            if (st.done < lo or st.done >= req.prompt.size
+                    or st.done % self.page_block):
+                raise InvariantViolation(
+                    f"slot {slot} (rid {req.rid}): torn chunk cursor — "
+                    f"done={st.done} outside [{lo}, {req.prompt.size}) "
+                    f"or not a multiple of page_block="
+                    f"{self.page_block}", shard=k)
+            owned = set(pool.owned_by(req.rid) if pool.owns(req.rid)
+                        else [])
+            if not set(int(p) for p in st.priv) <= owned:
+                raise InvariantViolation(
+                    f"slot {slot} (rid {req.rid}): chunk cursor's "
+                    f"private pages are not allocated to it", shard=k)
+            if not (set(int(p) for p in st.hit_pages)
+                    <= set(pool.acquired_by(req.rid))):
+                raise InvariantViolation(
+                    f"slot {slot} (rid {req.rid}): chunk cursor's hit "
+                    f"pages are not acquired by it", shard=k)
         for k, pool in enumerate(self.pools):
             orphans = pool.owners() - running_rids
             if orphans:

@@ -32,7 +32,10 @@ Three append-only streams:
 - ``prefills``: every prefill-batch span (t0, t1, rids, tokens) — the
   join cost that stalls every OTHER running slot's decode, which is the
   disaggregated-prefill motivation number servetrace's
-  ``prefill_stall`` component measures.
+  ``prefill_stall`` component measures. Chunk-drain spans (chunked
+  prefill, ISSUE 15) additionally carry per-row ``chunks`` records
+  (rid, chunk index, tokens) so the stall attribution and the per-rid
+  token conservation stay EXACT under interleaving.
 
 Clock discipline: timestamps come from the engine's ``_t(now)`` —
 ``clock()`` when set (wall time in benchmarks), else the step's virtual
@@ -130,15 +133,25 @@ class FlightRecorder:
         ph["schedule_admit"] += max(seg - inner, 0.0)
 
     def prefill(self, t0: float, t1: float, rids: list,
-                tokens: int) -> None:
+                tokens: int, chunks: list | None = None) -> None:
         """One prefill-batch span: dispatch + logits readback for the
         join batch ``rids`` (``tokens`` prompt tokens actually run).
         Lands in the global ``prefills`` stream AND the open step's
-        prefill_dispatch phase."""
+        prefill_dispatch phase.
+
+        ``chunks`` (chunked prefill, ISSUE 15): per-row
+        ``{"rid", "chunk", "tokens"}`` dicts when the span is a chunk
+        drain — the per-chunk records servetrace's fold-time
+        conservation check (sum of chunk tokens == admitted suffix
+        tokens per rid) and the CI budget-bound gate read. Absent on
+        monolithic join spans, so unchunked logs are byte-identical to
+        pre-ISSUE-15 records."""
         if not self.enabled:
             return
-        self.prefills.append({"t0": t0, "t1": t1, "rids": list(rids),
-                              "tokens": tokens})
+        rec = {"t0": t0, "t1": t1, "rids": list(rids), "tokens": tokens}
+        if chunks is not None:
+            rec["chunks"] = [dict(c) for c in chunks]
+        self.prefills.append(rec)
         self.span("prefill_dispatch", t0, t1)
 
     def end_step(self, t1: float, emits: list, evicts: list,

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""CI gate: chunked prefill must kill the p99 prefill stall — and change
+NOTHING else (ISSUE 15).
+
+Drives the SAME seeded spike workload (8 short prompts + one long
+straggler, staggered max_new so the shorts are mid-decode when the
+straggler joins) through a chunked engine and the monolithic-join
+baseline on a DETERMINISTIC work-proportional virtual clock: the
+engine's ``_PREFILL_CLOCK_HOOK`` seam charges 1 ms per prefill token
+between each prefill span's two clock reads, so the flight-recorder
+stall decomposition (analysis/servetrace.py) compares the two designs
+on trace structure alone — no wall jitter, bitwise-reproducible
+verdict. Asserts:
+
+- streams BIT-IDENTICAL chunked vs unchunked (every rid, every token),
+  both traces complete every request — equal completed-request goodput
+  by construction;
+- the baseline pays at least one prefill span over the budget (the
+  straggler's monolithic join — the contrast being gated exists);
+- the chunked trace's per-step prefill bill never exceeds
+  ``prefill_budget``, asserted from the flight records (every span is a
+  chunk drain, every span's tokens <= budget) AND the engine's
+  ``max_step_prefill_tokens`` telemetry;
+- chunked ``prefill_stall_p99_ms`` STRICTLY below unchunked — the
+  shorts still running at the straggler's admission each wait through
+  at most their remaining decode steps' worth of 8-token chunks
+  instead of the full 128-token prefill;
+- the chunked servetrace artifact carries the per-chunk records and
+  its fold-time conservation check (sum of chunk tokens == admitted
+  suffix tokens per rid) passes;
+- pool conservation (``check_idle``) on both engines.
+
+Run (CPU): scripts/run_tests_and_package.sh invokes this as the
+chunked-prefill gate. Exit 0 ok / 1 any assertion failed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np
+
+import jax
+
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.serving import Request, ServingEngine
+from cs336_systems_tpu.serving import engine as engine_mod
+
+CHUNK = BUDGET = 8
+SHORT, LONG, N_SHORT = 16, 128, 8
+TOK_S = 1e-3  # virtual seconds charged per prefill token (1 ms/token)
+
+
+def _cfg() -> TransformerConfig:
+    # the test model widened to a 256-token context so the straggler's
+    # prompt is 16 chunks long — enough steps for the shorts to finish
+    # progressively while its prefill drains
+    return TransformerConfig(vocab_size=64, context_length=256,
+                             d_model=64, d_ff=128, num_layers=2,
+                             num_heads=4)
+
+
+def _requests(rng: np.random.Generator) -> list[Request]:
+    lens = [SHORT] * N_SHORT + [LONG]
+    return [
+        Request(rid=i, prompt=rng.integers(0, 64, size=ln),
+                max_new_tokens=4 + i, arrival=0.0)
+        for i, ln in enumerate(lens)
+    ]
+
+
+class _WorkClock:
+    """Virtual trace clock: advances ONLY when the prefill hook charges
+    it, so every span duration is exactly its token count in ms."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def charge(self, tokens: int) -> None:
+        self.t += tokens * TOK_S
+
+
+def _run(params, cfg, chunked: bool):
+    clk = _WorkClock()
+    eng = ServingEngine(
+        params, cfg, key=jax.random.PRNGKey(0), slots=N_SHORT,
+        n_pages=64, max_blocks=-(-(LONG + 4 + N_SHORT) // 8),
+        page_block=8, temperature=0.9, top_k=8, clock=clk,
+        prefill_chunk=CHUNK if chunked else None,
+        prefill_budget=BUDGET if chunked else None)
+    engine_mod._PREFILL_CLOCK_HOOK = clk.charge
+    try:
+        for r in _requests(np.random.default_rng(7)):
+            eng.submit(r)
+        results = eng.run()
+    finally:
+        engine_mod._PREFILL_CLOCK_HOOK = None
+    eng.check_idle()  # pool conservation: the no-leak gate
+    return eng, results
+
+
+def main() -> int:
+    cfg = _cfg()
+    params = init_transformer_lm(jax.random.PRNGKey(1), cfg)
+    base_eng, base = _run(params, cfg, chunked=False)
+    chk_eng, chk = _run(params, cfg, chunked=True)
+
+    fails = []
+    n = N_SHORT + 1
+    if sorted(base) != list(range(n)) or sorted(chk) != list(range(n)):
+        fails.append(f"not every request completed: baseline "
+                     f"{sorted(base)}, chunked {sorted(chk)}")
+    for rid in base:
+        if not np.array_equal(base[rid], chk.get(rid)):
+            fails.append(f"rid {rid}: chunked stream diverges from the "
+                         f"monolithic baseline — not bit-identical")
+            break
+
+    base_art, chk_art = servetrace.fold(base_eng), servetrace.fold(chk_eng)
+    b99 = base_art["components_ms"]["prefill_stall"]["p99"]
+    c99 = chk_art["components_ms"]["prefill_stall"]["p99"]
+    if not any(p["tokens"] > BUDGET for p in base_eng.flight.prefills):
+        fails.append("baseline never exceeded the budget in one span — "
+                     "the workload lost its straggler contrast")
+    over = [p["tokens"] for p in chk_eng.flight.prefills
+            if p["tokens"] > BUDGET]
+    if over:
+        fails.append(f"chunked spans over budget {BUDGET}: {over}")
+    if any("chunks" not in p for p in chk_eng.flight.prefills):
+        fails.append("chunked engine emitted a prefill span without "
+                     "per-chunk records")
+    if chk_eng.max_step_prefill_tokens > BUDGET:
+        fails.append(f"max_step_prefill_tokens "
+                     f"{chk_eng.max_step_prefill_tokens} > budget {BUDGET}")
+    cons = chk_art["conservation"].get("prefill_chunks")
+    if not (cons and cons.get("ok")):
+        fails.append(f"chunk-token conservation missing or failed in the "
+                     f"servetrace artifact: {cons}")
+    if not c99 < b99:
+        fails.append(f"chunked prefill_stall p99 {c99:.3f} ms not "
+                     f"strictly below unchunked {b99:.3f} ms")
+
+    print(f"chunked-prefill gate: stall p99 {b99:.1f} -> {c99:.1f} ms "
+          f"(virtual 1 ms/token), {chk_eng.prefill_chunks} chunks, "
+          f"max step bill {chk_eng.max_step_prefill_tokens}/{BUDGET} "
+          f"tok, streams bit-identical over {len(base)} requests")
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

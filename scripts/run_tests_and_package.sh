@@ -337,6 +337,56 @@ EOF
 fi
 [ "$status" -eq 0 ] && status=$fleetsan_status
 
+# chunked-prefill gate (ISSUE 15): the interleaved-prefill engine family
+# through both analysis pipelines (the decode step program must stay
+# byte-identical to serve_engine's — lint pins the decode-only
+# collective contract verbatim, so chunking adds ZERO collectives), then
+# the deterministic stall gate (scripts/check_chunked_prefill_gate.py:
+# chunked streams bit-identical to the monolithic baseline, per-step
+# prefill bill <= prefill_budget from the flight records, and
+# prefill_stall_p99 STRICTLY down on a work-proportional virtual clock),
+# then a spike twin-cell run through the REAL benchmark driver — the
+# chunked cell and its identically-seeded unchunked twin must complete
+# every request (equal completed-request goodput) with the budget bound
+# holding in the engine telemetry. The two chunked servesan faults
+# (torn-chunk-state, leaked-chunk-pages) ride the --list loop above.
+JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+python -m cs336_systems_tpu.analysis.trace_cli --step serve_engine_chunked \
+    --iters 1 --out /tmp/chunked_smoke.stepprofile.json
+chunked_status=$?
+if [ "$chunked_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.analysis.mem_cli --step serve_engine_chunked \
+        --out /tmp/chunked_smoke.memprofile.json
+    chunked_status=$?
+fi
+if [ "$chunked_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/check_chunked_prefill_gate.py
+    chunked_status=$?
+fi
+if [ "$chunked_status" -eq 0 ]; then
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m cs336_systems_tpu.benchmarks.serving --test-model \
+        --requests 10 --loads 20 --new 6 --profiles spike \
+        --prefill-chunk 8 --out /tmp/chunked_smoke.jsonl
+    chunked_status=$?
+fi
+if [ "$chunked_status" -eq 0 ]; then
+    python - <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open("/tmp/chunked_smoke.jsonl")]
+bad = [r["name"] for r in rows
+       if r["completed"] != r["requests"]
+       or r["unchunked_completed"] != r["requests"]
+       or r["prefill_chunks"] < 1
+       or r["max_step_prefill_tokens"] > r["prefill_budget"]]
+sys.exit(1 if bad or not rows else 0)
+EOF
+    chunked_status=$?
+fi
+[ "$status" -eq 0 ] && status=$chunked_status
+
 zip -r "$OUT" . \
     -x "*.git*" -x "*__pycache__*" -x "*.pytest_cache*" \
     -x "*.zip" -x "*.npz" -x "*jax_trace*" -x "*.whl" -x "*.so" \

@@ -1,0 +1,227 @@
+"""Chunked prefill tests (ISSUE 15): splitting a join's prefill into
+page-aligned chunks drained into the decode loop is a SCHEDULE, not an
+approximation — every stream stays bit-identical to the row-keyed
+oracle (and therefore to the unchunked engine) for every arrival order,
+mesh, chunk size and prefix-cache setting; the jit decode step program
+is byte-identical chunking on or off; the per-step prefill bill never
+exceeds ``prefill_budget`` (asserted from the flight records); and a
+mid-prefill cancel releases every page the cursor held. Same oracle
+discipline as tests/test_serving_engine.py, whose fixtures this module
+mirrors.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cs336_systems_tpu.analysis import servetrace
+from cs336_systems_tpu.models.decode import generate_kv_batched
+from cs336_systems_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer_lm,
+)
+from cs336_systems_tpu.parallel.mesh import make_mesh
+from cs336_systems_tpu.serving import InvariantViolation, Request, ServingEngine
+
+CFG = TransformerConfig(
+    vocab_size=64, context_length=64, d_model=64,
+    num_layers=2, num_heads=4, d_ff=128,
+)
+BLK = 8
+NEW = 10
+LENS = [12, 3, 7, 1, 12, 5, 9, 2]  # test_paged_decode's skew profile
+
+ORDERS = {
+    "fifo": list(range(8)),
+    "shuffled": [5, 2, 7, 0, 3, 6, 1, 4],
+    "reversed": [7, 6, 5, 4, 3, 2, 1, 0],
+}
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer_lm(jax.random.PRNGKey(1), CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+            for n in LENS]
+
+
+def _oracle(params, prompts):
+    pmax = max(p.size for p in prompts)
+    padded = np.zeros((len(prompts), pmax), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :p.size] = p
+    return np.asarray(generate_kv_batched(
+        params, CFG, padded, NEW, jax.random.PRNGKey(0), temperature=0.9,
+        top_k=8, row_keyed=True, prompt_lens=[p.size for p in prompts],
+        page_block=BLK))
+
+
+@pytest.fixture(scope="module")
+def want(params, prompts):
+    return _oracle(params, prompts)
+
+
+def _engine(params, **kw):
+    base = dict(key=jax.random.PRNGKey(0), slots=8, n_pages=32,
+                max_blocks=4, page_block=BLK, temperature=0.9, top_k=8,
+                prefill_chunk=BLK)
+    base.update(kw)
+    return ServingEngine(params, CFG, **base)
+
+
+def _run(eng, prompts, order, staggered=True):
+    for i, r in enumerate(order):
+        eng.submit(Request(rid=r, prompt=prompts[r], max_new_tokens=NEW,
+                           arrival=float(i) * 0.25 if staggered else 0.0))
+    tick = iter(np.arange(0.0, 1e4, 0.5))
+    res = eng.run(time_fn=lambda: next(tick))
+    eng.check_idle()  # every page (incl. released cursors') back free
+    return res
+
+
+# --- the headline property: chunking never changes a stream -----------
+
+
+@pytest.mark.parametrize("order", sorted(ORDERS), ids=sorted(ORDERS))
+@pytest.mark.parametrize("cache", [True, False], ids=["cache", "nocache"])
+def test_chunked_matches_oracle_across_orders(params, prompts, want,
+                                              order, cache):
+    """Half the slots so requests queue and chunk drains interleave with
+    joins and evictions — streams equal the oracle row for row for every
+    arrival order, prefix cache on or off."""
+    eng = _engine(params, slots=4, n_pages=16, prefix_cache=cache)
+    res = _run(eng, prompts, ORDERS[order])
+    assert eng.prefill_chunks > 0  # the chunked path actually ran
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+def test_chunked_equals_unchunked_streams(params, prompts):
+    """The direct A/B: same arrivals through a chunked and an unchunked
+    engine — identical result dict, token for token."""
+    a = _run(_engine(params, prefill_chunk=None), prompts,
+             ORDERS["shuffled"])
+    b = _run(_engine(params, prefill_chunk=BLK, prefill_budget=2 * BLK),
+             prompts, ORDERS["shuffled"])
+    assert sorted(a) == sorted(b)
+    for r in a:
+        np.testing.assert_array_equal(a[r], b[r])
+
+
+@pytest.mark.parametrize("mesh_axes,dp,tp", [
+    ({"dp": 8}, "dp", None),
+    ({"dp": 2, "tp": 4}, "dp", "tp"),
+], ids=["dp8", "dp2xtp4"])
+def test_chunked_matches_oracle_on_mesh(params, prompts, want,
+                                        mesh_axes, dp, tp):
+    """Sharded slots: chunk drains batch per shard through the same
+    bucketed programs as suffix joins — still bit-identical."""
+    eng = _engine(params, slots=8, n_pages=8,
+                  mesh=make_mesh(mesh_axes), dp_axis=dp, tp_axis=tp)
+    res = _run(eng, prompts, [4, 1, 6, 0, 7, 2, 5, 3])
+    for r in range(len(prompts)):
+        np.testing.assert_array_equal(res[r], want[r])
+
+
+def test_chunked_with_shared_prefix_hits(params):
+    """Prefix-cache composition: only the UNCACHED suffix is chunked.
+    Staggered requests sharing a full prefix block — the first publishes
+    on completion, later ones acquire the hit pages and chunk only their
+    tails; streams still equal the oracle over the full prompts."""
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, CFG.vocab_size, size=BLK).astype(np.int32)
+    tails = [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+             for n in (9, 4, 12, 2)]
+    shared = [np.concatenate([prefix, t]) for t in tails]
+    want = _oracle(params, shared)
+    eng = _engine(params, slots=2, n_pages=16)
+    res = _run(eng, shared, list(range(len(shared))))
+    assert eng.prefix_hit_tokens > 0  # later requests really hit
+    for r in range(len(shared)):
+        np.testing.assert_array_equal(res[r], want[r])
+    # fold-time chunk-token conservation over the hit-adjusted suffixes
+    cons = servetrace.fold(eng)["conservation"]["prefill_chunks"]
+    assert cons["ok"] and cons["rids_checked"] == len(shared)
+
+
+# --- the zero-new-collectives contract, program-identity form ---------
+
+
+def test_step_program_byte_identical_chunking_on_off(params):
+    """Chunking is host-side admission state: the jit decode step the
+    two engines compile must LOWER to the same text, byte for byte."""
+    import jax.numpy as jnp
+
+    a, b = (_engine(params, prefill_chunk=c) for c in (None, BLK))
+    args = (params, a._pool, jnp.asarray(a.logits), jnp.asarray(a.keys),
+            jnp.asarray(a.pos), jnp.asarray(a.active),
+            jnp.asarray(a.row_off), jnp.asarray(a.tables))
+    assert (a._step_fn.lower(*args).as_text()
+            == b._step_fn.lower(*args).as_text())
+
+
+# --- the budget bound, from the flight records ------------------------
+
+
+def test_prefill_budget_bound(params, prompts):
+    """No step drains more than prefill_budget tokens: every flight
+    prefill span is a chunk drain at or under the budget, and the
+    engine's max_step_prefill_tokens telemetry agrees."""
+    eng = _engine(params, slots=4, n_pages=16, prefill_chunk=BLK,
+                  prefill_budget=BLK)
+    _run(eng, prompts, ORDERS["fifo"])
+    spans = eng.flight.prefills
+    assert spans and all("chunks" in p for p in spans)
+    assert max(p["tokens"] for p in spans) <= BLK
+    assert eng.max_step_prefill_tokens <= BLK
+    # per-rid conservation straight off the records: chunk tokens sum to
+    # each request's full prompt (no prefix cache hits in this run's
+    # distinct prompts)
+    got = {}
+    for p in spans:
+        for c in p["chunks"]:
+            got[c["rid"]] = got.get(c["rid"], 0) + c["tokens"]
+    assert got == {r: int(p.size) for r, p in enumerate(prompts)}
+
+
+# --- mid-prefill release + self_check --------------------------------
+
+
+def test_cancel_mid_prefill_releases_pages(params, prompts):
+    """Cancel between chunks: the cursor's pages free, the partial
+    stream is empty, and the pool conserves (check_idle passes)."""
+    eng = _engine(params, prefill_chunk=BLK, prefill_budget=BLK)
+    eng.submit(Request(rid=0, prompt=prompts[0], max_new_tokens=NEW))
+    eng.step(now=0.0)  # admits the cursor, drains chunk 0 of the 12-token
+    assert 0 in {st.req.rid for st in eng.prefilling.values()}
+    assert eng.cancel(0)
+    assert not eng.prefilling and 0 in eng.cancelled
+    assert eng.cancelled[0].size == 0  # no tokens ever emitted
+    eng.check_idle()
+
+
+def test_self_check_catches_torn_cursor(params, prompts):
+    """A cursor whose ``done`` leaves the page-aligned window is the
+    torn-chunk-state fault servesan injects — self_check must name it."""
+    eng = _engine(params, prefill_chunk=BLK)
+    eng.submit(Request(rid=0, prompt=prompts[0], max_new_tokens=NEW))
+    eng.step(now=0.0)
+    st = next(iter(eng.prefilling.values()))
+    st.done += 3
+    with pytest.raises(InvariantViolation, match="torn chunk cursor"):
+        eng.self_check()
+
+
+def test_chunk_config_validation(params):
+    with pytest.raises(ValueError, match="multiple of"):
+        _engine(params, prefill_chunk=BLK + 1)
+    with pytest.raises(ValueError, match="must be >="):
+        _engine(params, prefill_chunk=2 * BLK, prefill_budget=BLK)
+    with pytest.raises(ValueError, match="requires prefill_chunk"):
+        _engine(params, prefill_chunk=None, prefill_budget=BLK)

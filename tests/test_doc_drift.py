@@ -82,6 +82,22 @@ def test_fleetsan_fault_count_claims():
     assert int(m.group(1)) == live
 
 
+def test_servesan_fault_count_claims():
+    # ISSUE 15 satellite: the "(N seeded fault classes" claim in the
+    # CLAUDE.md servesan block and the analysis/README detection matrix
+    # must match what serving/chaos.py actually registers — the chunked
+    # faults (torn-chunk-state, leaked-chunk-pages) landed here once
+    from cs336_systems_tpu.serving import chaos
+
+    live = len(chaos.fault_names())
+    m = re.search(r"injects (\d+) seeded fault classes", CLAUDE_MD)
+    assert m, "CLAUDE.md servesan block lost its fault-count claim"
+    assert int(m.group(1)) == live
+    m = re.search(r"servesan.*?(\d+) fault classes", README, re.S)
+    assert m, "analysis/README.md servesan section lost its fault count"
+    assert int(m.group(1)) == live
+
+
 def test_lint_registry_matches_serve_and_train_families():
     # the lint registry = the 17 traced families + the kernel-level
     # gmm_fused_bwd step (README: "minus the kernel-level gmm_fused_bwd")
